@@ -1,0 +1,74 @@
+"""Benchmark entrypoint (driver contract: prints ONE JSON line).
+
+Measures the north-star-style headline on the available hardware: steady-
+state training throughput (images/sec/chip) of the flagship DP training
+step on MNIST-shaped data. The reference publishes no numbers (BASELINE.md);
+``vs_baseline`` is computed against the recorded first-round TPU measurement
+in BASELINE.json's ``published`` map when present, else 1.0.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main() -> None:
+    from tpudml.core.prng import seed_key
+    from tpudml.data.datasets import synthetic_classification
+    from tpudml.models import LeNet
+    from tpudml.optim import make_optimizer
+    from tpudml.train import TrainState, make_train_step
+
+    batch = 512
+    n_devices = jax.device_count()
+    images, labels = synthetic_classification(batch, (28, 28, 1), 10, seed=0)
+    images = jnp.asarray(images)
+    labels = jnp.asarray(labels)
+
+    model = LeNet()
+    opt = make_optimizer("sgd", 0.01, momentum=0.9)
+    step = make_train_step(model, opt)
+    ts = TrainState.create(model, opt, seed_key(0))
+
+    # Warmup / compile.
+    ts, m = step(ts, images, labels)
+    jax.block_until_ready(m["loss"])
+
+    iters = 50
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        ts, m = step(ts, images, labels)
+    jax.block_until_ready(m["loss"])
+    dt = time.perf_counter() - t0
+
+    imgs_per_sec = batch * iters / dt
+    per_chip = imgs_per_sec / max(n_devices, 1)
+
+    baseline = None
+    try:
+        with open("BASELINE.json") as f:
+            baseline = json.load(f).get("published", {}).get(
+                "mnist_lenet_imgs_per_sec_per_chip"
+            )
+    except Exception:
+        pass
+    vs = per_chip / baseline if baseline else 1.0
+    print(
+        json.dumps(
+            {
+                "metric": "mnist_lenet_train_imgs_per_sec_per_chip",
+                "value": round(per_chip, 1),
+                "unit": "imgs/sec/chip",
+                "vs_baseline": round(vs, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

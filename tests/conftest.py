@@ -1,0 +1,24 @@
+"""Test harness: simulated 8-device CPU mesh.
+
+The TPU-native analogue of the reference's multi-node-without-a-cluster
+story (mp.spawn / docker-compose, SURVEY.md §4): XLA's forced host-platform
+device count gives 8 fake devices on CPU, so every sharding/collective path
+is exercised in CI without TPU hardware. Must run before jax initializes.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,150 @@
+"""Unit tests: IDX codec, datasets, samplers (partition disjointness /
+coverage, per-epoch reshuffle, sampling-mode overlap), loaders."""
+
+import numpy as np
+import pytest
+
+from tpudml.data import (
+    DataLoader,
+    RandomPartitionSampler,
+    RandomSamplingSampler,
+    SequentialSampler,
+    load_dataset,
+    make_sampler,
+    read_idx,
+    write_idx,
+)
+from tpudml.data.datasets import ArrayDataset, synthetic_classification
+from tpudml.data.loader import ShardedDataLoader
+
+
+def test_idx_roundtrip(tmp_path):
+    for dtype in (np.uint8, np.int32, np.float32):
+        arr = (np.arange(2 * 3 * 4).reshape(2, 3, 4) % 200).astype(dtype)
+        p = tmp_path / f"x-{np.dtype(dtype).name}.idx"
+        write_idx(p, arr)
+        out = read_idx(p)
+        np.testing.assert_array_equal(out, arr)
+        assert out.dtype == dtype
+
+
+def test_idx_gzip_roundtrip(tmp_path):
+    arr = np.random.default_rng(0).integers(0, 255, (10, 28, 28)).astype(np.uint8)
+    p = tmp_path / "imgs.idx.gz"
+    write_idx(p, arr)
+    np.testing.assert_array_equal(read_idx(p), arr)
+
+
+def test_mnist_idx_loading(tmp_path):
+    """Write IDX files in the torchvision layout and load them through the
+    mnist loader (no synthetic fallback)."""
+    raw = tmp_path / "MNIST" / "raw"
+    raw.mkdir(parents=True)
+    imgs = np.random.default_rng(0).integers(0, 255, (50, 28, 28)).astype(np.uint8)
+    labels = (np.arange(50) % 10).astype(np.uint8)
+    write_idx(raw / "train-images-idx3-ubyte", imgs)
+    write_idx(raw / "train-labels-idx1-ubyte", labels)
+    ds = load_dataset("mnist", str(tmp_path), "train", synthetic_fallback=False)
+    assert ds.images.shape == (50, 28, 28, 1)
+    assert ds.images.dtype == np.float32
+    assert ds.images.max() <= 1.0
+    np.testing.assert_array_equal(ds.labels, labels)
+
+
+def test_synthetic_fallback_deterministic():
+    a = load_dataset("mnist", "/nonexistent", "train", synthetic_size=100)
+    b = load_dataset("mnist", "/nonexistent", "train", synthetic_size=100)
+    np.testing.assert_array_equal(a.images, b.images)
+    assert a.images.shape == (100, 28, 28, 1)
+
+
+def test_synthetic_is_learnable():
+    """Nearest-prototype classification must beat chance by a wide margin —
+    guarantees accuracy assertions in integration tests are meaningful."""
+    imgs, labels = synthetic_classification(500, (8, 8, 1), 10, seed=0)
+    protos = np.stack([imgs[labels == c].mean(0) for c in range(10)])
+    pred = np.argmin(
+        ((imgs[:, None] - protos[None]) ** 2).sum((2, 3, 4)), axis=1
+    )
+    assert (pred == labels).mean() > 0.9
+
+
+def test_partition_disjoint_and_exhaustive():
+    """Random-partition mode: shards are disjoint and cover the dataset
+    (sections/checking.tex:13)."""
+    n, world = 103, 4
+    samplers = [
+        RandomPartitionSampler(n, world, r, shuffle=True, seed=7) for r in range(world)
+    ]
+    shards = [set(s._indices().tolist()) for s in samplers]
+    union = set().union(*shards)
+    assert union == set(range(n))
+    # padding wraps a few indices; all NON-padded entries must be disjoint
+    total = sum(len(s) for s in shards)
+    assert total == -(-n // world) * world
+    overlap = sum(
+        len(a & b) for i, a in enumerate(shards) for b in shards[i + 1 :]
+    )
+    assert overlap <= total - n  # only the wrap-padding may repeat
+
+
+def test_partition_reshuffles_per_epoch():
+    s = RandomPartitionSampler(100, 2, 0, shuffle=True, seed=0)
+    e0 = s._indices().copy()
+    s.set_epoch(1)
+    e1 = s._indices()
+    assert not np.array_equal(e0, e1)
+    s.set_epoch(0)
+    np.testing.assert_array_equal(s._indices(), e0)
+
+
+def test_sampling_mode_overlaps_across_ranks():
+    """Random-sampling mode: per-rank independent draws overlap with high
+    probability and differ between ranks."""
+    n, world = 1000, 4
+    samplers = [
+        RandomSamplingSampler(n, world, r, shuffle=True, seed=0) for r in range(world)
+    ]
+    shards = [set(s._indices().tolist()) for s in samplers]
+    assert shards[0] != shards[1]
+    overlap = len(shards[0] & shards[1])
+    assert overlap > 0  # birthday bound: 250 draws from 1000 twice → overlap ~62
+
+
+def test_sampler_len_is_ceil():
+    s = RandomPartitionSampler(10, 3, 0)
+    assert len(s) == 4
+    assert len(list(iter(s))) == 4
+
+
+def test_make_sampler_factory():
+    assert isinstance(make_sampler("partition", 10, 2, 0), RandomPartitionSampler)
+    assert isinstance(make_sampler("sampling", 10, 2, 0), RandomSamplingSampler)
+    assert isinstance(make_sampler("sequential", 10, 2, 1), SequentialSampler)
+    with pytest.raises(ValueError):
+        make_sampler("bogus", 10, 2, 0)
+    with pytest.raises(ValueError):
+        make_sampler("partition", 10, 2, 5)
+
+
+def test_dataloader_batching():
+    imgs = np.arange(10, dtype=np.float32).reshape(10, 1, 1, 1)
+    ds = ArrayDataset(imgs, np.arange(10, dtype=np.int32))
+    ld = DataLoader(ds, batch_size=3, drop_remainder=True)
+    batches = list(ld)
+    assert len(batches) == 3 == len(ld)
+    assert all(b[0].shape == (3, 1, 1, 1) for b in batches)
+    ld2 = DataLoader(ds, batch_size=3, drop_remainder=False)
+    assert len(list(ld2)) == 4
+
+
+def test_sharded_loader_stacks_replicas():
+    imgs = np.arange(24, dtype=np.float32).reshape(24, 1, 1, 1)
+    ds = ArrayDataset(imgs, np.arange(24, dtype=np.int32))
+    samplers = [RandomPartitionSampler(24, 4, r, seed=3) for r in range(4)]
+    ld = ShardedDataLoader(ds, batch_size=2, samplers=samplers)
+    x, y = next(iter(ld))
+    assert x.shape == (4, 2, 1, 1, 1)
+    assert y.shape == (4, 2)
+    # per-replica streams are disjoint within the step
+    assert len(np.unique(y)) == 8

@@ -1,0 +1,88 @@
+"""Unit tests: nn layers and models (shapes, batchnorm state, LeNet parity
+dims)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpudml.models import ForwardMLP, LeNet, lenet_stages
+from tpudml.nn import BatchNorm, Conv2D, Dense, Dropout, MaxPool, Sequential
+
+
+def test_dense_shapes():
+    layer = Dense(4, 7)
+    params, state = layer.init(jax.random.key(0))
+    y, _ = layer.apply(params, state, jnp.ones((3, 4)))
+    assert y.shape == (3, 7)
+
+
+def test_conv_same_padding_preserves_hw():
+    layer = Conv2D(1, 6, kernel_size=5, padding=2)
+    params, _ = layer.init(jax.random.key(0))
+    y, _ = layer.apply(params, {}, jnp.ones((2, 28, 28, 1)))
+    assert y.shape == (2, 28, 28, 6)
+
+
+def test_maxpool():
+    y, _ = MaxPool(2).apply({}, {}, jnp.arange(16.0).reshape(1, 4, 4, 1))
+    assert y.shape == (1, 2, 2, 1)
+    np.testing.assert_allclose(np.asarray(y)[0, :, :, 0], [[5, 7], [13, 15]])
+
+
+def test_lenet_forward_shapes():
+    """Reference Net dims (codes/task1/pytorch/model.py:16-35): 28×28 input
+    → 400-dim flatten → 120 → 10."""
+    model = LeNet()
+    params, state = model.init(jax.random.key(0))
+    x = jnp.ones((5, 28, 28, 1))
+    y, _ = model.apply(params, state, x)
+    assert y.shape == (5, 10)
+    # fc1 kernel must be 400x120 (16*5*5 flatten)
+    assert params["layer7"]["kernel"].shape == (400, 120)
+
+
+def test_mlp_forward():
+    model = ForwardMLP()
+    params, state = model.init(jax.random.key(0))
+    y, _ = model.apply(params, state, jnp.ones((2, 28, 28, 1)))
+    assert y.shape == (2, 10)
+
+
+def test_staged_equals_composition():
+    """Staged LeNet must compute the same function shape-wise and run
+    stage-by-stage."""
+    model = lenet_stages()
+    params, state = model.init(jax.random.key(1))
+    x = jnp.ones((4, 28, 28, 1))
+    y, _ = model.apply(params, state, x)
+    assert y.shape == (4, 10)
+    assert model.stage_names() == ["conv", "fc"]
+
+
+def test_batchnorm_updates_state_in_train():
+    bn = BatchNorm(3, momentum=0.5)
+    params, state = bn.init(jax.random.key(0))
+    x = jnp.ones((8, 3)) * 4.0
+    y, new_state = bn.apply(params, state, x, train=True)
+    np.testing.assert_allclose(np.asarray(new_state["mean"]), [2.0] * 3, rtol=1e-6)
+    y_eval, same_state = bn.apply(params, state, x, train=False)
+    assert same_state is state
+
+
+def test_dropout_train_vs_eval():
+    d = Dropout(0.5)
+    x = jnp.ones((100, 100))
+    y_eval, _ = d.apply({}, {}, x, train=False)
+    np.testing.assert_array_equal(np.asarray(y_eval), np.asarray(x))
+    y_train, _ = d.apply({}, {}, x, train=True, rng=jax.random.key(0))
+    frac_zero = float(jnp.mean((y_train == 0).astype(jnp.float32)))
+    assert 0.4 < frac_zero < 0.6
+
+
+def test_sequential_threads_rng_and_state():
+    model = Sequential(layers=(Dense(4, 4), Dropout(0.5), BatchNorm(4)))
+    params, state = model.init(jax.random.key(0))
+    y, new_state = model.apply(
+        params, state, jnp.ones((2, 4)), train=True, rng=jax.random.key(1)
+    )
+    assert "layer2" in new_state

@@ -1,0 +1,109 @@
+"""Unit tests: optimizers against analytic updates (SURVEY.md §4 pyramid),
+including the reference's no-bias-correction Adam variant."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpudml.optim import Adam, GradientDescent, ReferenceAdam, Sgd, make_optimizer
+
+
+def tree_allclose(a, b, **kw):
+    flat_a, _ = jax.tree.flatten(a)
+    flat_b, _ = jax.tree.flatten(b)
+    for x, y in zip(flat_a, flat_b):
+        np.testing.assert_allclose(x, y, **kw)
+
+
+@pytest.fixture
+def params():
+    return {"w": jnp.array([1.0, -2.0, 3.0]), "b": jnp.array(0.5)}
+
+
+@pytest.fixture
+def grads():
+    return {"w": jnp.array([0.1, 0.2, -0.3]), "b": jnp.array(1.0)}
+
+
+def test_gd_analytic(params, grads):
+    opt = GradientDescent(lr=0.1)
+    state = opt.init(params)
+    new, _ = opt.update(grads, state, params)
+    tree_allclose(
+        new, {"w": jnp.array([0.99, -2.02, 3.03]), "b": jnp.array(0.4)}, rtol=1e-6
+    )
+
+
+def test_sgd_momentum_matches_torch_formula(params, grads):
+    # torch.optim.SGD: buf = mu*buf + g ; p -= lr*buf  (first step buf = g)
+    opt = Sgd(lr=0.01, momentum=0.9)
+    state = opt.init(params)
+    p1, s1 = opt.update(grads, state, params)
+    tree_allclose(p1, jax.tree.map(lambda p, g: p - 0.01 * g, params, grads), rtol=1e-6)
+    p2, _ = opt.update(grads, s1, p1)
+    # second step buf = 0.9*g + g = 1.9*g
+    tree_allclose(p2, jax.tree.map(lambda p, g: p - 0.01 * 1.9 * g, p1, grads), rtol=1e-6)
+
+
+def test_reference_adam_no_bias_correction(params, grads):
+    """First-step update must be lr * (1-b1)*g / (sqrt((1-b2)*g^2) + eps) —
+    the uncorrected form (reference MyOptimizer.py:35-43), NOT ≈ lr*sign(g)."""
+    lr, b1, b2, eps = 0.01, 0.9, 0.999, 1e-8
+    opt = ReferenceAdam(lr=lr, b1=b1, b2=b2, eps=eps)
+    new, _ = opt.update(grads, opt.init(params), params)
+    expected = jax.tree.map(
+        lambda p, g: p - lr * (1 - b1) * g / (jnp.sqrt((1 - b2) * g * g) + eps),
+        params,
+        grads,
+    )
+    tree_allclose(new, expected, rtol=1e-5)
+
+
+def test_standard_adam_first_step_is_signlike(params, grads):
+    """With bias correction the first update is ≈ -lr*sign(g)."""
+    opt = Adam(lr=0.01)
+    new, _ = opt.update(grads, opt.init(params), params)
+    delta = jax.tree.map(lambda n, p: n - p, new, params)
+    signs = jax.tree.map(lambda g: -0.01 * jnp.sign(g), grads)
+    tree_allclose(delta, signs, rtol=1e-3)
+
+
+def test_adam_variants_differ(params, grads):
+    a, _ = Adam(lr=0.01).update(grads, Adam(lr=0.01).init(params), params)
+    r, _ = ReferenceAdam(lr=0.01).update(
+        grads, ReferenceAdam(lr=0.01).init(params), params
+    )
+    assert not np.allclose(a["w"], r["w"])
+
+
+def test_update_is_jittable(params, grads):
+    opt = Adam(lr=0.01)
+    state = opt.init(params)
+    jitted = jax.jit(opt.update)
+    new, _ = jitted(grads, state, params)
+    ref, _ = opt.update(grads, state, params)
+    tree_allclose(new, ref, rtol=1e-6)
+
+
+def test_factory():
+    assert isinstance(make_optimizer("gd", 0.1), GradientDescent)
+    assert isinstance(make_optimizer("sgd", 0.1, 0.9), Sgd)
+    assert isinstance(make_optimizer("adam", 0.1), Adam)
+    assert isinstance(make_optimizer("adam_ref", 0.1), ReferenceAdam)
+    with pytest.raises(ValueError):
+        make_optimizer("lbfgs", 0.1)
+
+
+def test_optimizers_minimize_quadratic():
+    """Every optimizer must drive ||x||² down."""
+    for name in ("gd", "sgd", "adam", "adam_ref"):
+        opt = make_optimizer(name, 0.05, momentum=0.9)
+        x = {"x": jnp.array([3.0, -4.0])}
+        state = opt.init(x)
+        loss = lambda p: jnp.sum(p["x"] ** 2)
+        l0 = loss(x)
+        for _ in range(200):
+            grads = jax.grad(loss)(x)
+            x, state = opt.update(grads, state, x)
+        assert loss(x) < 0.05 * l0, name

@@ -1,0 +1,19 @@
+"""tpu-dml: a TPU-native (JAX/XLA) distributed machine-learning framework.
+
+Provides the full capability surface of the Tsinghua "Distributed Machine
+Learning" course lab suite (reference: Enigmatisms/
+Distributed-Machine-Learning-Experiment-Document, see SURVEY.md), re-designed
+TPU-first:
+
+- ``tpudml.core``     — config, mesh/device discovery, distributed init, PRNG.
+- ``tpudml.nn``       — functional (init/apply) neural-net module system.
+- ``tpudml.models``   — LeNet-style CNN, MLP, staged split nets.
+- ``tpudml.optim``    — hand-written GD / SGD(+momentum) / Adam as pure pytree
+                        transforms (reference: codes/task1/pytorch/MyOptimizer.py).
+- ``tpudml.data``     — MNIST/CIFAR-10 loaders (IDX parser + synthetic
+                        fallback), sampler framework (random partition /
+                        random sampling), per-host sharding.
+- ``tpudml.metrics``  — scalar metrics writer (reference: codes/datawriter.py).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,33 @@
+from tpudml.core.config import (
+    DataConfig,
+    DistributedConfig,
+    MeshConfig,
+    TrainConfig,
+)
+from tpudml.core.dist import (
+    distributed_init,
+    get_local_rank,
+    get_world_size,
+    local_device_count,
+    make_mesh,
+    process_count,
+    process_index,
+)
+from tpudml.core.prng import fold_in_epoch, key_for_step, seed_key
+
+__all__ = [
+    "DataConfig",
+    "DistributedConfig",
+    "MeshConfig",
+    "TrainConfig",
+    "distributed_init",
+    "get_local_rank",
+    "get_world_size",
+    "local_device_count",
+    "make_mesh",
+    "process_count",
+    "process_index",
+    "seed_key",
+    "key_for_step",
+    "fold_in_epoch",
+]

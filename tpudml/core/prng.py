@@ -1,0 +1,26 @@
+"""PRNG utilities.
+
+The reference relies on torch's implicit global RNG plus per-rank seeds
+(``seed=args.rank`` at codes/task3/model.py:111). JAX keys are explicit; these
+helpers give the framework one deterministic seeding discipline: a root key
+from the config seed, folded with epoch / step / rank as needed so every
+result is bit-reproducible from the config.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def seed_key(seed: int) -> jax.Array:
+    return jax.random.key(seed)
+
+
+def key_for_step(root: jax.Array, step: int) -> jax.Array:
+    return jax.random.fold_in(root, step)
+
+
+def fold_in_epoch(root: jax.Array, epoch: int) -> jax.Array:
+    """Sampler-style per-epoch reshuffle key — the ``set_epoch`` analogue
+    (reference: sections/task3.tex:52)."""
+    return jax.random.fold_in(root, epoch)

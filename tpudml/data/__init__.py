@@ -1,0 +1,25 @@
+from tpudml.data.datasets import ArrayDataset, load_cifar10, load_dataset, load_mnist
+from tpudml.data.idx import read_idx, write_idx
+from tpudml.data.loader import DataLoader
+from tpudml.data.sampler import (
+    RandomPartitionSampler,
+    RandomSamplingSampler,
+    Sampler,
+    SequentialSampler,
+    make_sampler,
+)
+
+__all__ = [
+    "ArrayDataset",
+    "load_dataset",
+    "load_mnist",
+    "load_cifar10",
+    "read_idx",
+    "write_idx",
+    "DataLoader",
+    "Sampler",
+    "SequentialSampler",
+    "RandomPartitionSampler",
+    "RandomSamplingSampler",
+    "make_sampler",
+]

@@ -1,0 +1,172 @@
+"""Dataset loading: MNIST / CIFAR-10 from disk, with a deterministic
+learnable synthetic fallback.
+
+The reference downloads MNIST via torchvision (codes/task1/pytorch/
+model.py:93-100). This framework reads the same IDX files offline from
+``data_dir``; when they are absent (e.g. air-gapped TPU-VM), it generates a
+deterministic synthetic classification problem with the same shapes so every
+entrypoint, test, and benchmark still runs end-to-end. The synthetic data is
+class-structured (per-class prototype + noise), so models actually learn and
+accuracy assertions remain meaningful.
+"""
+
+from __future__ import annotations
+
+import pickle
+import tarfile
+from dataclasses import dataclass
+from pathlib import Path
+
+import numpy as np
+
+from tpudml.data.idx import read_idx
+
+MNIST_FILES = {
+    "train_images": ["train-images-idx3-ubyte", "train-images.idx3-ubyte"],
+    "train_labels": ["train-labels-idx1-ubyte", "train-labels.idx1-ubyte"],
+    "test_images": ["t10k-images-idx3-ubyte", "t10k-images.idx3-ubyte"],
+    "test_labels": ["t10k-labels-idx1-ubyte", "t10k-labels.idx1-ubyte"],
+}
+
+
+@dataclass
+class ArrayDataset:
+    """In-memory dataset of (images, labels); the framework's Dataset role
+    in the reference's Dataset/Sampler/DataLoader triad
+    (sections/task3.tex:27-43)."""
+
+    images: np.ndarray  # [N, H, W, C] float32, normalized
+    labels: np.ndarray  # [N] int32
+    name: str = "dataset"
+
+    def __len__(self) -> int:
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        return self.images[idx], self.labels[idx]
+
+
+def _find_file(data_dir: Path, candidates: list[str]) -> Path | None:
+    # torchvision layout (MNIST/raw/...) and flat layout both supported.
+    for sub in ("", "MNIST/raw", "mnist", "raw"):
+        for name in candidates:
+            for suffix in ("", ".gz"):
+                p = data_dir / sub / (name + suffix)
+                if p.exists():
+                    return p
+    return None
+
+
+def synthetic_classification(
+    n: int,
+    shape: tuple[int, ...],
+    num_classes: int,
+    seed: int,
+    noise: float = 0.35,
+    proto_seed: int | None = None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Deterministic class-structured data: per-class prototype + Gaussian
+    noise, clipped to [0,1]. Learnable by a linear model yet not trivially
+    separable at high noise. ``proto_seed`` fixes the class prototypes
+    independently of the sample draw, so train/test splits share one
+    distribution (different ``seed``, same ``proto_seed``)."""
+    proto_rng = np.random.default_rng(seed if proto_seed is None else proto_seed)
+    protos = proto_rng.uniform(0.0, 1.0, size=(num_classes, *shape)).astype(np.float32)
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, num_classes, size=n).astype(np.int32)
+    imgs = protos[labels] + rng.normal(0.0, noise, size=(n, *shape)).astype(np.float32)
+    return np.clip(imgs, 0.0, 1.0).astype(np.float32), labels
+
+
+def load_mnist(
+    data_dir: str = "./data",
+    split: str = "train",
+    synthetic_fallback: bool = True,
+    synthetic_size: int | None = None,
+) -> ArrayDataset:
+    """MNIST as normalized float32 NHWC in [0,1].
+
+    Matches the reference's transform (ToTensor only — scales to [0,1],
+    codes/task1/pytorch/model.py:93-95; no mean/std normalization).
+    """
+    data_dir = Path(data_dir)
+    img_key = f"{split if split == 'train' else 'test'}_images"
+    lbl_key = f"{split if split == 'train' else 'test'}_labels"
+    img_path = _find_file(data_dir, MNIST_FILES[img_key])
+    lbl_path = _find_file(data_dir, MNIST_FILES[lbl_key])
+    if img_path is not None and lbl_path is not None:
+        images = read_idx(img_path).astype(np.float32) / 255.0
+        labels = read_idx(lbl_path).astype(np.int32)
+        images = images[..., None]  # [N,28,28,1]
+        return ArrayDataset(images, labels, name=f"mnist-{split}")
+    if not synthetic_fallback:
+        raise FileNotFoundError(f"MNIST IDX files not found under {data_dir}")
+    n = synthetic_size or (60000 if split == "train" else 10000)
+    imgs, labels = synthetic_classification(
+        n, (28, 28, 1), 10, seed=0 if split == "train" else 1, proto_seed=100
+    )
+    return ArrayDataset(imgs, labels, name=f"mnist-synthetic-{split}")
+
+
+def load_cifar10(
+    data_dir: str = "./data",
+    split: str = "train",
+    synthetic_fallback: bool = True,
+    synthetic_size: int | None = None,
+) -> ArrayDataset:
+    """CIFAR-10 python-pickle batches as float32 NHWC in [0,1]."""
+    data_dir = Path(data_dir)
+    base = None
+    for cand in (data_dir / "cifar-10-batches-py", data_dir):
+        if (cand / "data_batch_1").exists():
+            base = cand
+            break
+    tar = data_dir / "cifar-10-python.tar.gz"
+    if base is None and tar.exists():
+        with tarfile.open(tar) as tf:
+            tf.extractall(data_dir)
+        base = data_dir / "cifar-10-batches-py"
+    if base is not None:
+        files = (
+            [base / f"data_batch_{i}" for i in range(1, 6)]
+            if split == "train"
+            else [base / "test_batch"]
+        )
+        imgs, labels = [], []
+        for f in files:
+            with open(f, "rb") as fh:
+                d = pickle.load(fh, encoding="bytes")
+            imgs.append(d[b"data"])
+            labels.append(np.asarray(d[b"labels"]))
+        images = (
+            np.concatenate(imgs)
+            .reshape(-1, 3, 32, 32)
+            .transpose(0, 2, 3, 1)
+            .astype(np.float32)
+            / 255.0
+        )
+        return ArrayDataset(
+            images, np.concatenate(labels).astype(np.int32), name=f"cifar10-{split}"
+        )
+    if not synthetic_fallback:
+        raise FileNotFoundError(f"CIFAR-10 not found under {data_dir}")
+    n = synthetic_size or (50000 if split == "train" else 10000)
+    imgs, labels = synthetic_classification(
+        n, (32, 32, 3), 10, seed=2 if split == "train" else 3, proto_seed=101
+    )
+    return ArrayDataset(imgs, labels, name=f"cifar10-synthetic-{split}")
+
+
+def load_dataset(name: str, data_dir: str, split: str, **kw) -> ArrayDataset:
+    name = name.lower()
+    if name == "mnist":
+        return load_mnist(data_dir, split, **kw)
+    if name == "cifar10":
+        return load_cifar10(data_dir, split, **kw)
+    if name == "synthetic":
+        n = kw.get("synthetic_size") or (4096 if split == "train" else 1024)
+        imgs, labels = synthetic_classification(
+            n, (28, 28, 1), 10, seed=0 if split == "train" else 1, proto_seed=100
+        )
+        return ArrayDataset(imgs, labels, name=f"synthetic-{split}")
+    raise ValueError(f"unknown dataset {name!r}")

@@ -1,0 +1,61 @@
+"""IDX (MNIST) binary format reader/writer.
+
+The reference gets MNIST through ``torchvision.datasets.MNIST`` (codes/task1/
+pytorch/model.py:93-100), which reads the classic IDX files. This is a
+from-scratch, dependency-free decoder for the same on-disk format (and an
+encoder, used by tests and the synthetic-data cache), with an optional
+C++-accelerated path (tpudml/native) for large files.
+
+Format: big-endian; 2 zero bytes, 1 dtype byte, 1 ndim byte, then ndim
+uint32 dims, then row-major payload.
+"""
+
+from __future__ import annotations
+
+import gzip
+import struct
+from pathlib import Path
+
+import numpy as np
+
+_IDX_DTYPES = {
+    0x08: np.uint8,
+    0x09: np.int8,
+    0x0B: np.int16,
+    0x0C: np.int32,
+    0x0D: np.float32,
+    0x0E: np.float64,
+}
+_DTYPE_CODES = {np.dtype(v): k for k, v in _IDX_DTYPES.items()}
+
+
+def read_idx(path: str | Path) -> np.ndarray:
+    """Decode an IDX file (transparently handles .gz)."""
+    path = Path(path)
+    opener = gzip.open if path.suffix == ".gz" else open
+    with opener(path, "rb") as f:
+        data = f.read()
+    if len(data) < 4 or data[0] != 0 or data[1] != 0:
+        raise ValueError(f"{path}: not an IDX file (bad magic {data[:4]!r})")
+    dtype_code, ndim = data[2], data[3]
+    if dtype_code not in _IDX_DTYPES:
+        raise ValueError(f"{path}: unknown IDX dtype 0x{dtype_code:02x}")
+    dims = struct.unpack(f">{ndim}I", data[4 : 4 + 4 * ndim])
+    dtype = np.dtype(_IDX_DTYPES[dtype_code]).newbyteorder(">")
+    arr = np.frombuffer(data, dtype=dtype, count=int(np.prod(dims)), offset=4 + 4 * ndim)
+    return arr.reshape(dims).astype(_IDX_DTYPES[dtype_code])
+
+
+def write_idx(path: str | Path, arr: np.ndarray) -> None:
+    """Encode an array to IDX (used by tests / synthetic-data caching)."""
+    path = Path(path)
+    dtype = np.dtype(arr.dtype)
+    if dtype not in _DTYPE_CODES:
+        raise ValueError(f"dtype {dtype} not representable in IDX")
+    header = bytes([0, 0, _DTYPE_CODES[dtype], arr.ndim]) + struct.pack(
+        f">{arr.ndim}I", *arr.shape
+    )
+    payload = np.ascontiguousarray(arr).astype(dtype.newbyteorder(">")).tobytes()
+    opener = gzip.open if path.suffix == ".gz" else open
+    with opener(path, "wb") as f:
+        f.write(header + payload)

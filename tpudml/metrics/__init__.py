@@ -1,0 +1,3 @@
+from tpudml.metrics.writer import MetricsWriter, get_summary_writer
+
+__all__ = ["MetricsWriter", "get_summary_writer"]

@@ -1,0 +1,102 @@
+"""Metrics / observability.
+
+Re-design of the reference's TensorBoard writer factory (codes/
+datawriter.py:6-11: ``getSummaryWriter(epochs, del_dir)`` creating
+``./logs/{YYYY-MM-DD}/{HH-MM-SS}-epoch{N}/``) with a backend-pluggable
+scalar sink:
+
+- **jsonl** (default, dependency-free): one ``{"tag", "value", "step",
+  "wall_time"}`` record per line in ``metrics.jsonl`` — trivially parseable
+  by the bench harness and tests.
+- **tensorboard** (optional): if ``torch.utils.tensorboard`` is importable,
+  event files are written alongside, so the reference's TensorBoard workflow
+  keeps working unchanged.
+
+Extended beyond the reference with the scalars the TPU runtime cares about:
+per-chip throughput (``imgs_per_sec_per_chip``) and communication-time
+accounting (task2's measured quantity, codes/task2/model-mp.py:61-66) are
+just tags written through the same interface.
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import time
+from datetime import datetime
+from pathlib import Path
+
+
+class MetricsWriter:
+    def __init__(
+        self,
+        log_dir: str | Path,
+        run_name: str | None = None,
+        backends: tuple[str, ...] = ("jsonl",),
+        del_dir: bool = False,
+    ):
+        log_dir = Path(log_dir)
+        if del_dir and log_dir.exists():
+            shutil.rmtree(log_dir)
+        now = datetime.now()
+        # Timestamped layout parity: logs/<date>/<time>-<run_name>/; a
+        # collision suffix keeps runs started within one second separate.
+        sub = now.strftime("%H-%M-%S") + (f"-{run_name}" if run_name else "")
+        base = log_dir / now.strftime("%Y-%m-%d") / sub
+        self.run_dir = base
+        for i in range(1, 1000):
+            try:
+                self.run_dir.mkdir(parents=True, exist_ok=False)
+                break
+            except FileExistsError:
+                self.run_dir = base.with_name(f"{base.name}-{i}")
+        self._jsonl = None
+        self._tb = None
+        if "jsonl" in backends:
+            self._jsonl = open(self.run_dir / "metrics.jsonl", "a", buffering=1)
+        if "tensorboard" in backends:
+            try:
+                from torch.utils.tensorboard import SummaryWriter  # optional
+
+                self._tb = SummaryWriter(log_dir=str(self.run_dir))
+            except Exception:
+                self._tb = None
+
+    def add_scalar(self, tag: str, value, step: int) -> None:
+        """Reference-compatible scalar API (``writer.add_scalar('Train Loss',
+        loss, counter)``, codes/task1/pytorch/model.py:57-58)."""
+        rec = {
+            "tag": tag,
+            "value": float(value),
+            "step": int(step),
+            "wall_time": time.time(),
+        }
+        if self._jsonl:
+            self._jsonl.write(json.dumps(rec) + "\n")
+        if self._tb:
+            self._tb.add_scalar(tag, rec["value"], step)
+
+    def close(self) -> None:
+        if self._jsonl:
+            self._jsonl.close()
+        if self._tb:
+            self._tb.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def get_summary_writer(
+    epochs: int, del_dir: bool = False, log_dir: str = "./logs"
+) -> MetricsWriter:
+    """Drop-in analogue of the reference's ``getSummaryWriter(epochs,
+    del_dir)`` factory (codes/datawriter.py:6-11)."""
+    return MetricsWriter(
+        log_dir,
+        run_name=f"epoch{epochs}",
+        backends=("jsonl", "tensorboard"),
+        del_dir=del_dir,
+    )

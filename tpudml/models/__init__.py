@@ -1,0 +1,5 @@
+from tpudml.models.lenet import LeNet
+from tpudml.models.mlp import ForwardMLP
+from tpudml.models.staged import StagedModel, lenet_stages
+
+__all__ = ["LeNet", "ForwardMLP", "StagedModel", "lenet_stages"]

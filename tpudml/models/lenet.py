@@ -1,0 +1,34 @@
+"""LeNet-style CNN — the workhorse model of reference tasks 1–4.
+
+Architecture parity with the reference's ``Net`` (codes/task1/pytorch/
+model.py:16-35, reused verbatim in task2/task3 and split in task4):
+conv(1→6, k5, pad 2) → relu → maxpool2 → conv(6→16, k5, valid) → relu →
+maxpool2 → flatten(400) → fc(400→120) → relu → fc(120→10).
+
+Implemented NHWC (the XLA:TPU-preferred conv layout); the flatten ordering
+therefore differs from torch's NCHW flatten, which is immaterial — it is a
+permutation absorbed by the first fc kernel.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from tpudml.nn import Activation, Conv2D, Dense, Flatten, MaxPool, Sequential
+
+
+def LeNet(num_classes: int = 10, in_channels: int = 1) -> Sequential:
+    return Sequential(
+        layers=(
+            Conv2D(in_channels, 6, kernel_size=5, padding=2),
+            Activation(jax.nn.relu),
+            MaxPool(2),
+            Conv2D(6, 16, kernel_size=5, padding="VALID"),
+            Activation(jax.nn.relu),
+            MaxPool(2),
+            Flatten(),
+            Dense(400, 120),
+            Activation(jax.nn.relu),
+            Dense(120, num_classes),
+        )
+    )

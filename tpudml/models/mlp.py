@@ -1,0 +1,28 @@
+"""ForwardMLP — MindSpore-track parity model.
+
+Architecture parity with ``ForwardNN`` in the reference's MindSpore notebook
+(codes/task1/mindspore/model.ipynb cell 4): flatten(784) → 512 → 256 → 128 →
+64 → 32 → 10, relu between layers. The notebook's softmax head is folded
+into the loss (softmax cross-entropy over logits), as its
+``SoftmaxCrossEntropyWithLogits`` training path effectively does.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from tpudml.nn import Activation, Dense, Flatten, Sequential
+
+
+def ForwardMLP(
+    in_features: int = 784,
+    hidden: tuple[int, ...] = (512, 256, 128, 64, 32),
+    num_classes: int = 10,
+) -> Sequential:
+    layers: list = [Flatten()]
+    prev = in_features
+    for h in hidden:
+        layers += [Dense(prev, h), Activation(jax.nn.relu)]
+        prev = h
+    layers.append(Dense(prev, num_classes))
+    return Sequential(layers=tuple(layers))

@@ -1,0 +1,25 @@
+from tpudml.nn.layers import (
+    Activation,
+    AvgPool,
+    BatchNorm,
+    Conv2D,
+    Dense,
+    Dropout,
+    Flatten,
+    MaxPool,
+    Module,
+    Sequential,
+)
+
+__all__ = [
+    "Module",
+    "Dense",
+    "Conv2D",
+    "MaxPool",
+    "AvgPool",
+    "Flatten",
+    "Activation",
+    "BatchNorm",
+    "Dropout",
+    "Sequential",
+]

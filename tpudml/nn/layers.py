@@ -1,0 +1,255 @@
+"""Functional neural-net module system.
+
+A minimal init/apply layer framework in the JAX idiom: a ``Module`` is an
+immutable description; ``init(key)`` returns a parameter pytree and a state
+pytree (e.g. batch-norm running stats); ``apply(params, state, x, train=...)``
+is a pure function returning ``(y, new_state)``. Parameters are plain nested
+dicts, so the hand-written optimizers in ``tpudml.optim`` (reference:
+codes/task1/pytorch/MyOptimizer.py) operate on them directly as pytrees, and
+GSPMD sharding annotations attach to them without framework cooperation.
+
+Data layout is NHWC (channels-last), the layout XLA:TPU prefers for
+convolutions; the reference's NCHW torch models (codes/task1/pytorch/
+model.py:16-35) map onto this with identical math.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Params = Any
+State = Any
+
+
+class Module:
+    """Base class: immutable layer description with pure init/apply."""
+
+    def init(self, key: jax.Array) -> tuple[Params, State]:
+        return {}, {}
+
+    def apply(
+        self,
+        params: Params,
+        state: State,
+        x: jax.Array,
+        *,
+        train: bool = False,
+        rng: jax.Array | None = None,
+    ) -> tuple[jax.Array, State]:
+        raise NotImplementedError
+
+    # Convenience for stateless use.
+    def __call__(self, params, x, **kw):
+        y, _ = self.apply(params, {}, x, **kw)
+        return y
+
+
+def _uniform_fan_in(key, shape, fan_in, dtype):
+    """Kaiming-uniform à la torch's default Linear/Conv init: U(-b, b) with
+    b = 1/sqrt(fan_in). Keeps initial loss scale close to the reference's
+    torch models so loss curves are comparable."""
+    bound = 1.0 / jnp.sqrt(jnp.maximum(fan_in, 1.0))
+    return jax.random.uniform(key, shape, dtype, -bound, bound)
+
+
+@dataclass(frozen=True)
+class Dense(Module):
+    in_features: int
+    out_features: int
+    use_bias: bool = True
+    dtype: Any = jnp.float32
+
+    def init(self, key):
+        kw, kb = jax.random.split(key)
+        params = {
+            "kernel": _uniform_fan_in(
+                kw, (self.in_features, self.out_features), self.in_features, self.dtype
+            )
+        }
+        if self.use_bias:
+            params["bias"] = _uniform_fan_in(
+                kb, (self.out_features,), self.in_features, self.dtype
+            )
+        return params, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        y = x @ params["kernel"]
+        if self.use_bias:
+            y = y + params["bias"]
+        return y, state
+
+
+@dataclass(frozen=True)
+class Conv2D(Module):
+    """2-D convolution, NHWC x HWIO -> NHWC."""
+
+    in_channels: int
+    out_channels: int
+    kernel_size: int | tuple[int, int] = 3
+    stride: int | tuple[int, int] = 1
+    padding: str | int = "SAME"
+    use_bias: bool = True
+    dtype: Any = jnp.float32
+
+    def _ksize(self):
+        k = self.kernel_size
+        return (k, k) if isinstance(k, int) else tuple(k)
+
+    def init(self, key):
+        kh, kw_ = self._ksize()
+        fan_in = kh * kw_ * self.in_channels
+        kw, kb = jax.random.split(key)
+        params = {
+            "kernel": _uniform_fan_in(
+                kw, (kh, kw_, self.in_channels, self.out_channels), fan_in, self.dtype
+            )
+        }
+        if self.use_bias:
+            params["bias"] = _uniform_fan_in(kb, (self.out_channels,), fan_in, self.dtype)
+        return params, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        s = self.stride
+        strides = (s, s) if isinstance(s, int) else tuple(s)
+        if isinstance(self.padding, int):
+            p = self.padding
+            padding = [(p, p), (p, p)]
+        else:
+            padding = self.padding
+        y = lax.conv_general_dilated(
+            x,
+            params["kernel"],
+            window_strides=strides,
+            padding=padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        if self.use_bias:
+            y = y + params["bias"]
+        return y, state
+
+
+@dataclass(frozen=True)
+class MaxPool(Module):
+    window: int = 2
+    stride: int | None = None
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        w, s = self.window, self.stride or self.window
+        y = lax.reduce_window(
+            x, -jnp.inf, lax.max, (1, w, w, 1), (1, s, s, 1), "VALID"
+        )
+        return y, state
+
+
+@dataclass(frozen=True)
+class AvgPool(Module):
+    window: int = 2
+    stride: int | None = None
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        w, s = self.window, self.stride or self.window
+        y = lax.reduce_window(x, 0.0, lax.add, (1, w, w, 1), (1, s, s, 1), "VALID")
+        return y / (w * w), state
+
+
+@dataclass(frozen=True)
+class Flatten(Module):
+    def apply(self, params, state, x, *, train=False, rng=None):
+        return x.reshape(x.shape[0], -1), state
+
+
+@dataclass(frozen=True)
+class Activation(Module):
+    fn: Callable[[jax.Array], jax.Array] = jax.nn.relu
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        return self.fn(x), state
+
+
+@dataclass(frozen=True)
+class Dropout(Module):
+    rate: float = 0.5
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        if not train or self.rate == 0.0:
+            return x, state
+        if rng is None:
+            raise ValueError("Dropout in train mode requires an rng")
+        keep = 1.0 - self.rate
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0), state
+
+
+@dataclass(frozen=True)
+class BatchNorm(Module):
+    """Batch normalization with running-average inference statistics."""
+
+    num_features: int
+    momentum: float = 0.9
+    eps: float = 1e-5
+    dtype: Any = jnp.float32
+
+    def init(self, key):
+        params = {
+            "scale": jnp.ones((self.num_features,), self.dtype),
+            "bias": jnp.zeros((self.num_features,), self.dtype),
+        }
+        state = {
+            "mean": jnp.zeros((self.num_features,), self.dtype),
+            "var": jnp.ones((self.num_features,), self.dtype),
+        }
+        return params, state
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        axes = tuple(range(x.ndim - 1))
+        if train:
+            mean = jnp.mean(x, axes)
+            var = jnp.var(x, axes)
+            m = self.momentum
+            new_state = {
+                "mean": m * state["mean"] + (1 - m) * mean,
+                "var": m * state["var"] + (1 - m) * var,
+            }
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        y = (x - mean) * lax.rsqrt(var + self.eps)
+        return y * params["scale"] + params["bias"], new_state
+
+
+@dataclass(frozen=True)
+class Sequential(Module):
+    """Chain of modules; params/state are dicts keyed ``layer{i}``."""
+
+    layers: Sequence[Module] = field(default_factory=tuple)
+
+    def init(self, key):
+        params, state = {}, {}
+        keys = jax.random.split(key, max(len(self.layers), 1))
+        for i, (layer, k) in enumerate(zip(self.layers, keys)):
+            p, s = layer.init(k)
+            if p:
+                params[f"layer{i}"] = p
+            if s:
+                state[f"layer{i}"] = s
+        return params, state
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        new_state = {}
+        rngs = (
+            jax.random.split(rng, max(len(self.layers), 1)) if rng is not None else None
+        )
+        for i, layer in enumerate(self.layers):
+            p = params.get(f"layer{i}", {})
+            s = state.get(f"layer{i}", {})
+            x, s2 = layer.apply(
+                p, s, x, train=train, rng=rngs[i] if rngs is not None else None
+            )
+            if s2:
+                new_state[f"layer{i}"] = s2
+        return x, new_state

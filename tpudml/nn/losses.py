@@ -1,0 +1,24 @@
+"""Loss and metric functions.
+
+The reference uses ``nn.CrossEntropyLoss`` over logits + integer labels
+(codes/task1/pytorch/model.py:103) and argmax top-1 accuracy in ``test()``
+(model.py:67-81); these are the pure-function equivalents.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def softmax_cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean softmax cross-entropy over integer labels (torch
+    CrossEntropyLoss semantics, reduction='mean')."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32), axis=-1)
+    return jnp.mean(nll)
+
+
+def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Top-1 accuracy."""
+    return jnp.mean((jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))

@@ -1,0 +1,17 @@
+from tpudml.optim.optimizers import (
+    Adam,
+    GradientDescent,
+    Optimizer,
+    ReferenceAdam,
+    Sgd,
+    make_optimizer,
+)
+
+__all__ = [
+    "Optimizer",
+    "GradientDescent",
+    "Sgd",
+    "Adam",
+    "ReferenceAdam",
+    "make_optimizer",
+]

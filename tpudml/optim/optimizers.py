@@ -1,0 +1,155 @@
+"""Hand-written first-order optimizers as pure pytree transforms.
+
+TPU-native re-design of the reference's custom optimizer layer
+(codes/task1/pytorch/MyOptimizer.py): where the reference mutates
+``p.data`` in a per-parameter Python loop, these are pure functions over
+parameter pytrees, so the entire update fuses into the jitted train step
+(one XLA program — no per-parameter kernel launches).
+
+The reference's eager-mode ``zero_grad`` (grad detach + zero,
+MyOptimizer.py:11-15) has no analogue here: ``jax.grad`` returns fresh
+gradients each step by construction, which is the semantic the detach
+requirement was enforcing.
+
+Contract: ``init(params) -> state``; ``update(grads, state, params) ->
+(new_params, new_state)``. Both are jit-compatible and work on any pytree.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+PyTree = Any
+
+
+class Optimizer:
+    """Base optimizer. Subclasses implement init/update as pure functions.
+
+    Reference parity: ``BaseOptimizer`` (MyOptimizer.py:3-15) holds params +
+    lr and defines step/zero_grad; here state is explicit and updates are
+    functional.
+    """
+
+    def init(self, params: PyTree) -> PyTree:
+        return ()
+
+    def update(self, grads: PyTree, state: PyTree, params: PyTree) -> tuple[PyTree, PyTree]:
+        raise NotImplementedError
+
+
+@dataclass(frozen=True)
+class GradientDescent(Optimizer):
+    """Vanilla gradient descent: ``p -= lr * g``.
+
+    Reference parity: ``GdOptimizer`` (MyOptimizer.py:18-24). Whether it acts
+    as GD or SGD is a property of the data pipeline (full batch vs
+    minibatch), as in the reference labs (sections/task1.tex:8-23).
+    """
+
+    lr: float = 1e-3
+
+    def update(self, grads, state, params):
+        new_params = jax.tree.map(lambda p, g: p - self.lr * g, params, grads)
+        return new_params, state
+
+
+@dataclass(frozen=True)
+class Sgd(Optimizer):
+    """SGD with (optional) heavy-ball momentum, matching torch.optim.SGD's
+    formulation used by the distributed tasks (codes/task2/model.py:131:
+    ``SGD(lr=0.01, momentum=0.9)``): ``buf = mu*buf + g; p -= lr*buf``.
+    """
+
+    lr: float = 1e-2
+    momentum: float = 0.0
+
+    def init(self, params):
+        if self.momentum == 0.0:
+            return ()
+        return jax.tree.map(jnp.zeros_like, params)
+
+    def update(self, grads, state, params):
+        if self.momentum == 0.0:
+            return jax.tree.map(lambda p, g: p - self.lr * g, params, grads), state
+        new_buf = jax.tree.map(lambda b, g: self.momentum * b + g, state, grads)
+        new_params = jax.tree.map(lambda p, b: p - self.lr * b, params, new_buf)
+        return new_params, new_buf
+
+
+@dataclass(frozen=True)
+class Adam(Optimizer):
+    """Standard Adam (Kingma & Ba) with bias correction."""
+
+    lr: float = 1e-3
+    b1: float = 0.9
+    b2: float = 0.999
+    eps: float = 1e-8
+
+    def init(self, params):
+        zeros = lambda: jax.tree.map(jnp.zeros_like, params)
+        return {"m": zeros(), "v": zeros(), "t": jnp.zeros((), jnp.int32)}
+
+    def update(self, grads, state, params):
+        t = state["t"] + 1
+        m = jax.tree.map(lambda m_, g: self.b1 * m_ + (1 - self.b1) * g, state["m"], grads)
+        v = jax.tree.map(
+            lambda v_, g: self.b2 * v_ + (1 - self.b2) * g * g, state["v"], grads
+        )
+        tf = t.astype(jnp.float32)
+        c1 = 1.0 - self.b1**tf
+        c2 = 1.0 - self.b2**tf
+        new_params = jax.tree.map(
+            lambda p, m_, v_: p - self.lr * (m_ / c1) / (jnp.sqrt(v_ / c2) + self.eps),
+            params,
+            m,
+            v,
+        )
+        return new_params, {"m": m, "v": v, "t": t}
+
+
+@dataclass(frozen=True)
+class ReferenceAdam(Optimizer):
+    """The reference's hand-written Adam WITHOUT bias correction
+    (codes/task1/pytorch/MyOptimizer.py:26-43): ``m = b1*m + (1-b1)*g;
+    v = b2*v + (1-b2)*g²; p -= lr * m / (sqrt(v) + eps)`` — the m̂/v̂ terms
+    are absent. Reproduced faithfully (and separately from standard Adam)
+    because task1's training behavior, including its early-step update
+    scale, depends on it.
+    """
+
+    lr: float = 1e-3
+    b1: float = 0.9
+    b2: float = 0.999
+    eps: float = 1e-8
+
+    def init(self, params):
+        zeros = lambda: jax.tree.map(jnp.zeros_like, params)
+        return {"m": zeros(), "v": zeros()}
+
+    def update(self, grads, state, params):
+        m = jax.tree.map(lambda m_, g: self.b1 * m_ + (1 - self.b1) * g, state["m"], grads)
+        v = jax.tree.map(
+            lambda v_, g: self.b2 * v_ + (1 - self.b2) * g * g, state["v"], grads
+        )
+        new_params = jax.tree.map(
+            lambda p, m_, v_: p - self.lr * m_ / (jnp.sqrt(v_) + self.eps), params, m, v
+        )
+        return new_params, {"m": m, "v": v}
+
+
+def make_optimizer(name: str, lr: float, momentum: float = 0.0) -> Optimizer:
+    """Factory used by the task entrypoints' ``--optimizer`` flag."""
+    name = name.lower()
+    if name == "gd":
+        return GradientDescent(lr=lr)
+    if name == "sgd":
+        return Sgd(lr=lr, momentum=momentum)
+    if name == "adam":
+        return Adam(lr=lr)
+    if name in ("adam_ref", "reference_adam"):
+        return ReferenceAdam(lr=lr)
+    raise ValueError(f"unknown optimizer {name!r}")

@@ -1,0 +1,156 @@
+"""Training engine: jitted step functions + host-side epoch loops.
+
+The reference's per-batch eager hot loop (forward → loss → zero_grad →
+backward → step, codes/task1/pytorch/model.py:44-61) becomes ONE jitted XLA
+program per step — the MindSpore notebook's sink-mode graph training
+(model.ipynb cell 6) is the closest reference analogue of this execution
+model (SURVEY.md §3.5). Distributed variants in ``tpudml.parallel`` reuse
+the same loss/step structure under shard_map / pjit.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from tpudml.metrics import MetricsWriter
+from tpudml.nn.layers import Module
+from tpudml.nn.losses import accuracy, softmax_cross_entropy
+from tpudml.optim import Optimizer
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class TrainState:
+    """Everything that evolves during training, as one pytree."""
+
+    params: Any
+    model_state: Any  # e.g. batch-norm running stats
+    opt_state: Any
+    step: jax.Array
+
+    @classmethod
+    def create(cls, model: Module, optimizer: Optimizer, key: jax.Array) -> "TrainState":
+        params, model_state = model.init(key)
+        return cls(
+            params=params,
+            model_state=model_state,
+            opt_state=optimizer.init(params),
+            step=jnp.zeros((), jnp.int32),
+        )
+
+
+def make_loss_fn(
+    model: Module, loss: Callable = softmax_cross_entropy
+) -> Callable:
+    """(params, model_state, images, labels[, rng]) -> (loss, (new_model_state,
+    logits))."""
+
+    def loss_fn(params, model_state, images, labels, rng=None):
+        logits, new_state = model.apply(
+            params, model_state, images, train=True, rng=rng
+        )
+        return loss(logits, labels), (new_state, logits)
+
+    return loss_fn
+
+
+def make_train_step(
+    model: Module, optimizer: Optimizer, rng_root: jax.Array | None = None
+) -> Callable:
+    """Jitted single-device train step: grad + optimizer update fused into
+    one XLA program. ``rng_root`` (optional) seeds per-step dropout keys,
+    folded with the step counter inside the program."""
+    loss_fn = make_loss_fn(model)
+
+    @jax.jit
+    def step(ts: TrainState, images, labels):
+        rng = None if rng_root is None else jax.random.fold_in(rng_root, ts.step)
+        (loss, (model_state, logits)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(ts.params, ts.model_state, images, labels, rng)
+        new_params, new_opt = optimizer.update(grads, ts.opt_state, ts.params)
+        new_ts = TrainState(
+            params=new_params,
+            model_state=model_state,
+            opt_state=new_opt,
+            step=ts.step + 1,
+        )
+        return new_ts, {"loss": loss, "accuracy": accuracy(logits, labels)}
+
+    return step
+
+
+def make_eval_step(model: Module) -> Callable:
+    @jax.jit
+    def step(params, model_state, images, labels):
+        logits, _ = model.apply(params, model_state, images, train=False)
+        correct = jnp.sum((jnp.argmax(logits, -1) == labels).astype(jnp.int32))
+        return correct
+
+    return step
+
+
+def evaluate(model: Module, ts: TrainState, loader) -> float:
+    """Top-1 test accuracy, reference ``test()`` parity (codes/task1/
+    pytorch/model.py:67-81)."""
+    step = make_eval_step(model)
+    correct, total = 0, 0
+    for images, labels in loader:
+        correct += int(step(ts.params, ts.model_state, images, labels))
+        total += len(labels)
+    return correct / max(total, 1)
+
+
+def train_loop(
+    model: Module,
+    optimizer: Optimizer,
+    train_loader,
+    num_epochs: int,
+    key: jax.Array,
+    writer: MetricsWriter | None = None,
+    log_every: int = 20,
+    step_fn: Callable | None = None,
+    state: TrainState | None = None,
+    hooks: list[Callable] | None = None,
+) -> tuple[TrainState, dict]:
+    """Host-side epoch loop with the reference's logging cadence (loss every
+    ``log_every`` iters, codes/task1/pytorch/model.py:57-61) and total
+    wall-clock accounting (codes/task2/model-mp.py:48,76-78)."""
+    ts = state or TrainState.create(model, optimizer, key)
+    # Dropout keys derive from a domain-separated branch of the init key.
+    step = step_fn or make_train_step(
+        model, optimizer, rng_root=jax.random.fold_in(key, 0x0D0)
+    )
+    counter = 0
+    t0 = time.time()
+    metrics = None  # device values; materialized to floats only on log/exit
+    for epoch in range(num_epochs):
+        if hasattr(train_loader, "set_epoch"):
+            train_loader.set_epoch(epoch)
+        for images, labels in train_loader:
+            ts, metrics = step(ts, images, labels)
+            counter += 1
+            if counter % log_every == 0:
+                loss = float(metrics["loss"])
+                if writer is not None:
+                    writer.add_scalar("Train Loss", loss, counter)
+                print(f"epoch {epoch} iter {counter}: loss {loss:.4f}")
+            for h in hooks or ():
+                h(epoch=epoch, step=counter, train_state=ts, metrics=metrics)
+    jax.block_until_ready(ts.params)
+    train_time = time.time() - t0
+    print(f"Training time: {train_time:.3f}s")
+    if writer is not None:
+        writer.add_scalar("Train Time", train_time, counter)
+    last_metrics = (
+        {k: float(v) for k, v in metrics.items()} if metrics is not None else {}
+    )
+    last_metrics["train_time_s"] = train_time
+    last_metrics["steps"] = counter
+    return ts, last_metrics
