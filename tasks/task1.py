@@ -37,19 +37,27 @@ def reference_defaults() -> TrainConfig:
 
 
 def run(cfg: TrainConfig) -> dict:
-    train_set = load_dataset(cfg.data.dataset, cfg.data.data_dir, "train")
-    test_set = load_dataset(cfg.data.dataset, cfg.data.data_dir, "test")
+    train_set = load_dataset(
+        cfg.data.dataset, cfg.data.data_dir, "train",
+        synthetic_fallback=cfg.data.synthetic_fallback,
+    )
+    test_set = load_dataset(
+        cfg.data.dataset, cfg.data.data_dir, "test",
+        synthetic_fallback=cfg.data.synthetic_fallback,
+    )
     from tpudml.data.sampler import make_sampler
 
     sampler = make_sampler(
-        "partition" if cfg.data.shuffle else "sequential",
+        cfg.data.division if cfg.data.shuffle else "sequential",
         len(train_set),
         1,
         0,
         shuffle=cfg.data.shuffle,
         seed=cfg.data.seed,
     )
-    train_loader = DataLoader(train_set, cfg.data.batch_size, sampler)
+    train_loader = DataLoader(
+        train_set, cfg.data.batch_size, sampler, drop_remainder=cfg.data.drop_remainder
+    )
     test_loader = DataLoader(test_set, cfg.data.batch_size, drop_remainder=False)
 
     model = LeNet(in_channels=train_set.images.shape[-1])

@@ -3,7 +3,13 @@
 The TPU-native analogue of the reference's multi-node-without-a-cluster
 story (mp.spawn / docker-compose, SURVEY.md §4): XLA's forced host-platform
 device count gives 8 fake devices on CPU, so every sharding/collective path
-is exercised in CI without TPU hardware. Must run before jax initializes.
+is exercised in CI without TPU hardware.
+
+Note: platform selection uses ``jax.config.update`` rather than the
+JAX_PLATFORMS env var — in environments where a site hook imports jax at
+interpreter startup (e.g. a preloaded TPU PJRT plugin), the env var is
+already latched by the time conftest runs; the config API still works as
+long as no backend has been initialized.
 """
 
 import os
@@ -14,6 +20,10 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,127 @@
+"""Collective wrappers vs numpy references on a simulated 8-device mesh
+(SURVEY.md §4 test pyramid: collective equivalence tier)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpudml.comm import (
+    allgather_average_gradients,
+    allreduce_average_gradients,
+    broadcast_from,
+    ppermute_ring,
+    psum_tree,
+    reduce_scatter_average_gradients,
+)
+from tpudml.comm.collectives import all_to_all, get_aggregator
+from tpudml.core.config import MeshConfig
+from tpudml.core.dist import make_mesh
+from tpudml.parallel.sharding import shard_map_fn
+
+WORLD = 8
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(MeshConfig({"data": WORLD}))
+
+
+def per_replica_values(rng, shape=(WORLD, 4, 3)):
+    return rng.standard_normal(shape).astype(np.float32)
+
+
+def run_sharded(mesh, fn, x, in_axis="data", out_spec=P()):
+    """Apply fn under shard_map with x sharded on its leading axis."""
+    sharded = jax.device_put(jnp.asarray(x), NamedSharding(mesh, P("data")))
+    wrapped = shard_map_fn(fn, mesh, in_specs=P("data"), out_specs=out_spec)
+    return np.asarray(jax.jit(wrapped)(sharded))
+
+
+def test_psum_tree_matches_numpy_sum(mesh, rng):
+    x = per_replica_values(rng)
+    out = run_sharded(mesh, lambda v: psum_tree(v, "data"), x)
+    # Each shard contributes one [1,4,3] slice; psum -> sum over replicas.
+    np.testing.assert_allclose(out[0], x.sum(0), rtol=1e-5)
+
+
+def test_allreduce_mean_matches_numpy_mean(mesh, rng):
+    x = per_replica_values(rng)
+    out = run_sharded(mesh, lambda v: allreduce_average_gradients(v, "data"), x)
+    np.testing.assert_allclose(out[0], x.mean(0), rtol=1e-5)
+
+
+def test_allgather_mean_equals_allreduce_mean(mesh, rng):
+    """The two task2 aggregation strategies are mathematically identical
+    (sections/checking.tex:20-21 compares their COST, not results). Also
+    pins the fix of the reference's [zeros]*2 allgather bug
+    (codes/task2/dist_utils.py:44-49) for any world size."""
+    x = per_replica_values(rng)
+
+    def body(v):
+        v = v[0]  # strip shard dim -> per-replica value
+        ar = allreduce_average_gradients(v, "data")
+        ag = allgather_average_gradients(v, "data")
+        return ar[None], ag[None]
+
+    sharded = jax.device_put(jnp.asarray(x), NamedSharding(mesh, P("data")))
+    wrapped = shard_map_fn(
+        body, mesh, in_specs=P("data"), out_specs=(P("data"), P("data"))
+    )
+    ar, ag = jax.jit(wrapped)(sharded)
+    np.testing.assert_allclose(np.asarray(ar), np.asarray(ag), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(ar)[0], x.mean(0), rtol=1e-5)
+
+
+def test_reduce_scatter_mean_equals_mean(mesh, rng):
+    x = per_replica_values(rng, (WORLD, WORLD * 2, 3))  # leading dim divisible
+    out = run_sharded(mesh, lambda v: reduce_scatter_average_gradients(v[0], "data")[None], x, out_spec=P("data"))
+    np.testing.assert_allclose(out[0], x.mean(0), rtol=1e-5)
+
+
+def test_reduce_scatter_falls_back_on_indivisible(mesh, rng):
+    x = per_replica_values(rng, (WORLD, 3, 2))  # 3 not divisible by 8
+    out = run_sharded(mesh, lambda v: reduce_scatter_average_gradients(v[0], "data")[None], x, out_spec=P("data"))
+    np.testing.assert_allclose(out[0], x.mean(0), rtol=1e-5)
+
+
+def test_broadcast_from_root(mesh, rng):
+    x = per_replica_values(rng)
+    root = 3
+
+    def body(v):
+        return broadcast_from(v, "data", root=root)
+
+    out = run_sharded(mesh, body, x, out_spec=P("data"))
+    # Every replica ends with root's value.
+    for r in range(WORLD):
+        np.testing.assert_allclose(out[r], x[root], rtol=1e-6)
+
+
+def test_ppermute_ring_shift(mesh, rng):
+    x = per_replica_values(rng)
+    out = run_sharded(mesh, lambda v: ppermute_ring(v, "data", 1), x, out_spec=P("data"))
+    # replica i's value lands on replica i+1.
+    for r in range(WORLD):
+        np.testing.assert_allclose(out[(r + 1) % WORLD], x[r], rtol=1e-6)
+
+
+def test_all_to_all_transposes_shard_axis(mesh, rng):
+    # Each replica holds [1, WORLD, 2]; all_to_all swaps the sharded axis
+    # with the local axis (Ulysses-style sequence redistribution).
+    x = rng.standard_normal((WORLD, WORLD, 2)).astype(np.float32)
+    out = run_sharded(
+        mesh,
+        lambda v: all_to_all(v, "data", split_axis=1, concat_axis=0),
+        x,
+        out_spec=P("data"),
+    )
+    np.testing.assert_allclose(
+        out.reshape(WORLD, WORLD, 2), x.transpose(1, 0, 2), rtol=1e-6
+    )
+
+
+def test_get_aggregator_rejects_unknown():
+    with pytest.raises(ValueError):
+        get_aggregator("ring-of-power")

@@ -1,0 +1,139 @@
+"""Data-parallel engine tests on the simulated 8-device mesh.
+
+The load-bearing property (SURVEY.md §4 integration tier): a DP step over N
+replicas with aggregated gradients is mathematically the same optimization
+as a single-device step on the concatenated global batch — so DP-vs-single
+loss curves must match to float tolerance given the same seed and global
+batch.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from tpudml.core.config import MeshConfig
+from tpudml.core.dist import make_mesh
+from tpudml.core.prng import seed_key
+from tpudml.data.datasets import synthetic_classification
+from tpudml.models import LeNet
+from tpudml.optim import make_optimizer
+from tpudml.parallel.dp import DataParallel
+from tpudml.train import TrainState, make_train_step
+
+WORLD = 8
+PER_REPLICA = 4
+GLOBAL = WORLD * PER_REPLICA
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(MeshConfig({"data": WORLD}))
+
+
+@pytest.fixture(scope="module")
+def batch():
+    images, labels = synthetic_classification(GLOBAL, (28, 28, 1), 10, seed=7)
+    return np.asarray(images), np.asarray(labels)
+
+
+def params_allclose(a, b, rtol=1e-5, atol=1e-6):
+    flat_a = jax.tree.leaves(a)
+    flat_b = jax.tree.leaves(b)
+    assert len(flat_a) == len(flat_b)
+    for xa, xb in zip(flat_a, flat_b):
+        np.testing.assert_allclose(np.asarray(xa), np.asarray(xb), rtol=rtol, atol=atol)
+
+
+def run_steps(step, ts, batch, n=3):
+    losses = []
+    for _ in range(n):
+        ts, m = step(ts, *batch)
+        losses.append(float(m["loss"]))
+    return ts, losses
+
+
+@pytest.mark.parametrize("aggregation", ["allreduce", "allgather", "reducescatter"])
+def test_dp_matches_single_device(mesh, batch, aggregation):
+    model = LeNet()
+    opt = make_optimizer("sgd", 0.01, momentum=0.9)
+
+    dp = DataParallel(model, opt, mesh, aggregation=aggregation)
+    ts_dp = dp.create_state(seed_key(0))
+    step_dp = dp.make_train_step()
+    ts_dp, losses_dp = run_steps(step_dp, ts_dp, batch)
+
+    ts_1 = TrainState.create(model, opt, seed_key(0))
+    step_1 = make_train_step(model, opt)
+    ts_1, losses_1 = run_steps(step_1, ts_1, batch)
+
+    np.testing.assert_allclose(losses_dp, losses_1, rtol=1e-4)
+    params_allclose(ts_dp.params, ts_1.params, rtol=1e-4, atol=1e-5)
+
+
+def test_split_step_matches_fused_and_counts_comm(mesh, batch):
+    model = LeNet()
+    opt = make_optimizer("sgd", 0.01, momentum=0.9)
+
+    fused = DataParallel(model, opt, mesh)
+    ts_f = fused.create_state(seed_key(0))
+    ts_f, losses_f = run_steps(fused.make_train_step(), ts_f, batch)
+
+    split = DataParallel(model, opt, mesh, measure_comm=True)
+    ts_s = split.create_state(seed_key(0))
+    ts_s, losses_s = run_steps(split.make_train_step(), ts_s, batch)
+
+    np.testing.assert_allclose(losses_s, losses_f, rtol=1e-4)
+    params_allclose(ts_s.params, ts_f.params, rtol=1e-4, atol=1e-5)
+    assert split.comm_stats.calls == 3
+    assert split.comm_stats.comm_time_s > 0.0
+
+
+def test_bottleneck_injection_slows_steps(mesh, batch):
+    import time
+
+    model = LeNet()
+    opt = make_optimizer("sgd", 0.01)
+    delay = 0.05
+
+    base = DataParallel(model, opt, mesh, measure_comm=True)
+    ts = base.create_state(seed_key(0))
+    step = base.make_train_step()
+    step(ts, *batch)  # compile
+    t0 = time.perf_counter()
+    step(ts, *batch)
+    base_time = time.perf_counter() - t0
+
+    slow = DataParallel(
+        model, opt, mesh, measure_comm=True,
+        bottleneck_rank=0, bottleneck_delay_s=delay,
+    )
+    ts2 = slow.create_state(seed_key(0))
+    step2 = slow.make_train_step()
+    step2(ts2, *batch)
+    t0 = time.perf_counter()
+    step2(ts2, *batch)
+    slow_time = time.perf_counter() - t0
+
+    assert slow_time >= base_time + 0.8 * delay
+
+
+def test_broadcast_params_restores_agreement(mesh):
+    model = LeNet()
+    opt = make_optimizer("sgd", 0.01)
+    dp = DataParallel(model, opt, mesh)
+    ts = dp.create_state(seed_key(3))
+    ts_b = dp.broadcast_params(ts)
+    params_allclose(ts_b.params, ts.params, rtol=0, atol=0)
+
+
+def test_sharded_stacked_batch_accepted(mesh):
+    """ShardedDataLoader's [world, B, ...] form flattens correctly."""
+    model = LeNet()
+    opt = make_optimizer("sgd", 0.01)
+    dp = DataParallel(model, opt, mesh)
+    images = np.random.default_rng(0).normal(size=(WORLD, 2, 28, 28, 1)).astype(np.float32)
+    labels = np.zeros((WORLD, 2), np.int32)
+    ts = dp.create_state(seed_key(0))
+    ts2, m = dp.make_train_step()(ts, images, labels)
+    assert int(ts2.step) == 1
+    assert np.isfinite(float(m["loss"]))

@@ -1,0 +1,38 @@
+"""Integration: task2 end-to-end on the simulated 8-device mesh —
+DP training converges, both aggregation strategies work, comm-time and
+bottleneck accounting are produced (SURVEY.md §4 integration tier)."""
+
+import pytest
+
+import tasks.task2 as task2
+from tpudml.core.config import TrainConfig
+
+
+def small_cfg(tmp_path, **overrides) -> TrainConfig:
+    cfg = task2.reference_defaults()
+    cfg.epochs = 2
+    cfg.lr = 0.05  # synthetic smoke run: converge within 2 short epochs
+    cfg.log_every = 50
+    cfg.log_dir = str(tmp_path / "logs")
+    cfg.data.dataset = "synthetic"
+    cfg.data.batch_size = 8  # per-replica
+    for k, v in overrides.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+@pytest.mark.parametrize("aggregation", ["allreduce", "allgather"])
+def test_task2_end_to_end(tmp_path, aggregation):
+    cfg = small_cfg(tmp_path, aggregation=aggregation)
+    metrics = task2.run(cfg)
+    assert metrics["world"] == 8
+    assert metrics["test_accuracy"] > 0.5
+    assert metrics["loss"] < 2.3
+
+
+def test_task2_measure_comm_and_bottleneck(tmp_path):
+    cfg = small_cfg(tmp_path, measure_comm=True, bottleneck_rank=0)
+    cfg.bottleneck_delay_s = 0.01
+    metrics = task2.run(cfg)
+    assert metrics["comm_time_s"] > 0.0
+    assert metrics["test_accuracy"] > 0.4

@@ -1,0 +1,36 @@
+"""Communication layer: collective wrappers + comm-time accounting.
+
+TPU-native replacement of the reference's ``dist_utils`` trio
+(codes/task{2,3,4}/dist_utils.py — three near-identical copies, unified
+here per SURVEY.md §1). Collectives ride XLA's TPU fabric (ICI intra-slice,
+DCN cross-host) instead of NCCL/gloo process groups.
+"""
+
+from tpudml.comm.collectives import (
+    allgather_average_gradients,
+    allreduce_average_gradients,
+    all_gather_tree,
+    all_to_all,
+    broadcast_from,
+    pmean_tree,
+    ppermute_ring,
+    psum_scatter_tree,
+    psum_tree,
+    reduce_scatter_average_gradients,
+)
+from tpudml.comm.timing import CommStats, comm_time_trial
+
+__all__ = [
+    "allgather_average_gradients",
+    "allreduce_average_gradients",
+    "all_gather_tree",
+    "all_to_all",
+    "broadcast_from",
+    "pmean_tree",
+    "ppermute_ring",
+    "psum_scatter_tree",
+    "psum_tree",
+    "reduce_scatter_average_gradients",
+    "CommStats",
+    "comm_time_trial",
+]

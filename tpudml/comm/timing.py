@@ -1,0 +1,104 @@
+"""Communication-time accounting.
+
+The reference measures comm cost by bracketing the per-step allreduce call
+with ``time.time()`` and accumulating ``comm_time_sum`` (codes/task2/
+model-mp.py:61-66, printed :79; GPU-accurate recipe via cuda Events,
+sections/task2.tex:69-80). Under XLA that span does not exist: collectives
+are scheduled inside one fused jitted program (SURVEY.md §7 "hard parts").
+
+Two mechanisms reproduce the capability:
+
+1. **Split-step mode** (``measure_comm=True`` in the DP engine): the step is
+   deliberately compiled as two XLA programs — (a) local grads, (b)
+   aggregate + apply — and the host brackets program (b) with
+   ``block_until_ready`` timers. This trades fusion for measurability,
+   exactly the trade the reference's eager loop makes implicitly.
+2. **comm_time_trial**: times an aggregation strategy in isolation on a
+   gradient-shaped pytree (jitted, warmed up, block_until_ready-bracketed) —
+   the cleanest way to produce task2's AllReduce-vs-AllGather comparison
+   table (sections/checking.tex:20-21) without perturbing training.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import numpy as np
+
+
+@dataclass
+class CommStats:
+    """Accumulates the reference's ``comm_time_sum`` (model-mp.py:48,79)."""
+
+    comm_time_s: float = 0.0
+    calls: int = 0
+    per_call_s: list = field(default_factory=list)
+
+    def add(self, dt: float) -> None:
+        self.comm_time_s += dt
+        self.calls += 1
+        self.per_call_s.append(dt)
+
+    def report(self) -> str:
+        # Reference print parity: "Total communication time:" (model-mp.py:79).
+        return f"Total communication time: {self.comm_time_s:.4f}s over {self.calls} calls"
+
+
+def timed_call(stats: CommStats, fn: Callable, *args) -> Any:
+    """Run ``fn`` (a jitted program) and charge its wall time to ``stats``.
+
+    ``block_until_ready`` on the output plays the role of
+    ``torch.cuda.synchronize`` in the reference's Event recipe
+    (sections/task2.tex:72-80): without it the async dispatch would make the
+    span meaningless.
+    """
+    t0 = time.perf_counter()
+    out = fn(*args)
+    jax.block_until_ready(out)
+    stats.add(time.perf_counter() - t0)
+    return out
+
+
+def comm_time_trial(
+    mesh,
+    grads_like: Any,
+    aggregator: Callable,
+    axis_name: str = "data",
+    iters: int = 20,
+    warmup: int = 3,
+) -> dict:
+    """Median/total wall time of one aggregation strategy in isolation.
+
+    Compiles ``aggregator`` alone under shard_map over ``mesh`` and times it
+    on synthetic gradients shaped like ``grads_like``.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpudml.parallel.sharding import shard_map_fn
+
+    agg = shard_map_fn(
+        partial(aggregator, axis_name=axis_name),
+        mesh,
+        in_specs=P(),
+        out_specs=P(),
+    )
+    agg = jax.jit(agg)
+    grads = jax.device_put(grads_like, NamedSharding(mesh, P()))
+    for _ in range(warmup):
+        jax.block_until_ready(agg(grads))
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(agg(grads))
+        times.append(time.perf_counter() - t0)
+    times_arr = np.asarray(times)
+    return {
+        "median_s": float(np.median(times_arr)),
+        "mean_s": float(times_arr.mean()),
+        "total_s": float(times_arr.sum()),
+        "iters": iters,
+    }

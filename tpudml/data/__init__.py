@@ -1,6 +1,6 @@
 from tpudml.data.datasets import ArrayDataset, load_cifar10, load_dataset, load_mnist
 from tpudml.data.idx import read_idx, write_idx
-from tpudml.data.loader import DataLoader
+from tpudml.data.loader import DataLoader, ShardedDataLoader
 from tpudml.data.sampler import (
     RandomPartitionSampler,
     RandomSamplingSampler,
@@ -17,6 +17,7 @@ __all__ = [
     "read_idx",
     "write_idx",
     "DataLoader",
+    "ShardedDataLoader",
     "Sampler",
     "SequentialSampler",
     "RandomPartitionSampler",

@@ -124,7 +124,10 @@ def load_cifar10(
     tar = data_dir / "cifar-10-python.tar.gz"
     if base is None and tar.exists():
         with tarfile.open(tar) as tf:
-            tf.extractall(data_dir)
+            try:
+                tf.extractall(data_dir, filter="data")  # no path traversal
+            except TypeError:  # Python < 3.12 has no filter kwarg
+                tf.extractall(data_dir)
         base = data_dir / "cifar-10-batches-py"
     if base is not None:
         files = (

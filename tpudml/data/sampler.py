@@ -91,12 +91,14 @@ class RandomSamplingSampler(Sampler):
     ``seed=rank`` discipline) — replicas draw overlapping samples."""
 
     def _indices(self) -> np.ndarray:
+        if not self.shuffle:
+            # Without shuffling, independent per-rank draws would collapse to
+            # every rank reading the same head of the dataset; degrade to the
+            # strided disjoint shard instead (SequentialSampler semantics).
+            padded = _pad_to_multiple(np.arange(self.dataset_size), self.num_replicas)
+            return padded[self.rank :: self.num_replicas]
         rng = np.random.default_rng((self.seed, self.rank, self.epoch))
-        if self.shuffle:
-            order = rng.permutation(self.dataset_size)
-        else:
-            order = np.arange(self.dataset_size)
-        return order[: self.num_samples]
+        return rng.permutation(self.dataset_size)[: self.num_samples]
 
 
 def _pad_to_multiple(order: np.ndarray, m: int) -> np.ndarray:

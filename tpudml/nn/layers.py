@@ -43,9 +43,10 @@ class Module:
     ) -> tuple[jax.Array, State]:
         raise NotImplementedError
 
-    # Convenience for stateless use.
-    def __call__(self, params, x, **kw):
-        y, _ = self.apply(params, {}, x, **kw)
+    # Convenience call; pass ``state`` for models with stateful layers
+    # (e.g. BatchNorm running stats), whose apply would KeyError on {}.
+    def __call__(self, params, x, state=None, **kw):
+        y, _ = self.apply(params, state if state is not None else {}, x, **kw)
         return y
 
 

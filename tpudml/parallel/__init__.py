@@ -1,0 +1,28 @@
+"""Parallelism engines: DP (shard_map+psum), MP (GSPMD stage sharding),
+sharded optimizer state (parameter-server analogue).
+
+Re-designs of the reference's three strategies (SURVEY.md §2.3): task2/3's
+replicated-model gradient-allreduce DP, task4's RPC inter-layer model split,
+and task4's DistributedOptimizer parameter-server pattern — all expressed as
+sharding annotations over one ``jax.sharding.Mesh`` instead of process
+groups and RPC.
+"""
+
+from tpudml.parallel.sharding import (
+    data_sharding,
+    replicate,
+    replicated_sharding,
+    shard_batch,
+    shard_map_fn,
+)
+from tpudml.parallel.dp import DataParallel, make_dp_train_step
+
+__all__ = [
+    "DataParallel",
+    "make_dp_train_step",
+    "data_sharding",
+    "replicate",
+    "replicated_sharding",
+    "shard_batch",
+    "shard_map_fn",
+]

@@ -1,0 +1,282 @@
+"""Data parallelism: one jitted SPMD step over a mesh ``data`` axis.
+
+Re-design of the reference's DDP loop (codes/task2/model.py:40-72,
+codes/task3/model.py:39-64): replicated params, per-replica data shard,
+per-step gradient aggregation. Where the reference runs one process per
+rank and issues one NCCL collective per parameter tensor (SURVEY.md §3.2),
+here the entire step — forward, backward, aggregation, optimizer update —
+is ONE XLA program sharded over the mesh; XLA schedules the gradient
+collectives on ICI and fuses them with the update.
+
+Two execution modes:
+
+- **fused** (default): maximum-performance single program.
+- **split / measure_comm**: the step compiles as separate XLA programs for
+  (local grads) and (aggregate), so the host can time the communication
+  span and inject a straggler delay before the collective — reproducing
+  task2's comm-time accounting and bottleneck-node experiment
+  (codes/task2/model-mp.py:47-66, sections/task2.tex:18-19).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpudml.comm.collectives import broadcast_from, get_aggregator, pmean_tree
+from tpudml.comm.timing import CommStats
+from tpudml.core.dist import process_index
+from tpudml.nn.layers import Module
+from tpudml.nn.losses import accuracy
+from tpudml.optim import Optimizer
+from tpudml.parallel.sharding import (
+    data_sharding,
+    replicate,
+    shard_map_fn,
+)
+from tpudml.train import TrainState, make_loss_fn
+
+PyTree = Any
+
+
+class DataParallel:
+    """DP training engine over a mesh ``data`` axis.
+
+    Usage::
+
+        dp = DataParallel(model, opt, mesh, aggregation="allreduce")
+        ts = dp.create_state(key)          # replicated on the mesh
+        step = dp.make_train_step()        # (ts, images, labels) -> (ts, metrics)
+
+    ``images``/``labels`` are global batches (leading dim = world ×
+    per-replica batch); the engine shards them over the data axis.
+    """
+
+    def __init__(
+        self,
+        model: Module,
+        optimizer: Optimizer,
+        mesh: Mesh,
+        axis_name: str = "data",
+        aggregation: str = "allreduce",
+        measure_comm: bool = False,
+        bottleneck_rank: int | None = None,
+        bottleneck_delay_s: float = 0.1,
+        rng_root: jax.Array | None = None,
+    ):
+        self.model = model
+        self.optimizer = optimizer
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.aggregation = aggregation
+        self.aggregator = get_aggregator(aggregation)
+        self.measure_comm = measure_comm
+        self.bottleneck_rank = bottleneck_rank
+        self.bottleneck_delay_s = bottleneck_delay_s
+        self.rng_root = rng_root
+        self.comm_stats = CommStats()
+        self.world = mesh.shape[axis_name]
+        self._loss_fn = make_loss_fn(model)
+
+    # ---------------------------------------------------------------- state
+
+    def create_state(self, key: jax.Array) -> TrainState:
+        """Init once on host, place replicated on every mesh device.
+
+        Covers the reference's ``init_parameters`` broadcast contract
+        (codes/task2/dist_utils.py:33-37): every replica starts from
+        bitwise-identical params — here by construction rather than by a
+        rank-0 collective (see also :meth:`broadcast_params`).
+        """
+        ts = TrainState.create(self.model, self.optimizer, key)
+        return replicate(ts, self.mesh)
+
+    def broadcast_params(self, ts: TrainState, root: int = 0) -> TrainState:
+        """Explicit rank-``root`` parameter broadcast (reference-mechanism
+        parity; needed only when replicas may have diverged, e.g. after a
+        per-host restore)."""
+        fn = shard_map_fn(
+            lambda p: broadcast_from(p, self.axis_name, root),
+            self.mesh,
+            in_specs=P(),
+            out_specs=P(),
+        )
+        return TrainState(
+            params=jax.jit(fn)(ts.params),
+            model_state=ts.model_state,
+            opt_state=ts.opt_state,
+            step=ts.step,
+        )
+
+    def shard_batch(self, images, labels):
+        """Place a global [world×B, ...] host batch sharded over the data
+        axis. Accepts the ShardedDataLoader's stacked [world, B, ...] form
+        too (flattened so device r receives replica r's rows)."""
+        sharding = data_sharding(self.mesh, self.axis_name)
+        images = jnp.asarray(images)
+        labels = jnp.asarray(labels)
+        if labels.ndim == 2 and labels.shape[0] == self.world:
+            images = images.reshape(-1, *images.shape[2:])
+            labels = labels.reshape(-1)
+        return jax.device_put(images, sharding), jax.device_put(labels, sharding)
+
+    # ----------------------------------------------------------- fused step
+
+    def make_train_step(self) -> Callable:
+        if self.measure_comm:
+            return self._make_split_step()
+        return self._make_fused_step()
+
+    def _spmd_body(self, ts: TrainState, images, labels):
+        """Per-shard step body (runs under shard_map)."""
+        rng = None
+        if self.rng_root is not None:
+            # Distinct dropout streams per replica and per step.
+            rng = jax.random.fold_in(
+                jax.random.fold_in(self.rng_root, ts.step),
+                jax.lax.axis_index(self.axis_name),
+            )
+        (loss, (model_state, logits)), grads = jax.value_and_grad(
+            self._loss_fn, has_aux=True
+        )(ts.params, ts.model_state, images, labels, rng)
+        grads = self.aggregator(grads, self.axis_name)
+        # Cross-replica-consistent BN stats: average the running stats so
+        # every replica holds the same model_state (the reference's DDP
+        # leaves them divergent per rank; averaged is strictly better and
+        # keeps params/state replicated).
+        model_state = pmean_tree(model_state, self.axis_name)
+        new_params, new_opt = self.optimizer.update(grads, ts.opt_state, ts.params)
+        metrics = {
+            "loss": jax.lax.pmean(loss, self.axis_name),
+            "accuracy": jax.lax.pmean(accuracy(logits, labels), self.axis_name),
+        }
+        new_ts = TrainState(
+            params=new_params,
+            model_state=model_state,
+            opt_state=new_opt,
+            step=ts.step + 1,
+        )
+        return new_ts, metrics
+
+    def _make_fused_step(self) -> Callable:
+        spmd = shard_map_fn(
+            self._spmd_body,
+            self.mesh,
+            in_specs=(P(), P(self.axis_name), P(self.axis_name)),
+            out_specs=(P(), P()),
+        )
+        jitted = jax.jit(spmd)
+
+        def step(ts: TrainState, images, labels):
+            images, labels = self.shard_batch(images, labels)
+            return jitted(ts, images, labels)
+
+        return step
+
+    # ----------------------------------------------------------- split step
+
+    def _make_split_step(self) -> Callable:
+        """Two XLA programs + host-timed communication span.
+
+        Program A (per-shard grads, no collectives) → [host: optional
+        straggler sleep, reference model-mp.py:47,64-65] → program B
+        (aggregate; TIMED — the ``comm_time_sum`` span of model-mp.py:61-66)
+        → program C (optimizer apply).
+        """
+        axis = self.axis_name
+
+        def local_grads(ts: TrainState, images, labels):
+            rng = None
+            if self.rng_root is not None:
+                rng = jax.random.fold_in(
+                    jax.random.fold_in(self.rng_root, ts.step),
+                    jax.lax.axis_index(axis),
+                )
+            (loss, (model_state, logits)), grads = jax.value_and_grad(
+                self._loss_fn, has_aux=True
+            )(ts.params, ts.model_state, images, labels, rng)
+            # Stack per-replica values on a leading axis so the host gets
+            # them un-aggregated (out_spec P(axis) ⇒ [world, ...]).
+            stack = lambda t: jax.tree.map(lambda x: x[None], t)
+            return stack(grads), stack(model_state), stack(
+                {"loss": loss, "accuracy": accuracy(logits, labels)}
+            )
+
+        grad_fn = jax.jit(
+            shard_map_fn(
+                local_grads,
+                self.mesh,
+                in_specs=(P(), P(axis), P(axis)),
+                out_specs=(P(axis), P(axis), P(axis)),
+            )
+        )
+
+        def aggregate(stacked_grads, stacked_state):
+            unstack = lambda t: jax.tree.map(lambda x: x[0], t)
+            grads = self.aggregator(unstack(stacked_grads), axis)
+            model_state = pmean_tree(unstack(stacked_state), axis)
+            return grads, model_state
+
+        agg_fn = jax.jit(
+            shard_map_fn(
+                aggregate,
+                self.mesh,
+                in_specs=(P(axis), P(axis)),
+                out_specs=(P(), P()),
+            )
+        )
+
+        @jax.jit
+        def apply_fn(ts: TrainState, grads, model_state):
+            new_params, new_opt = self.optimizer.update(grads, ts.opt_state, ts.params)
+            return TrainState(
+                params=new_params,
+                model_state=model_state,
+                opt_state=new_opt,
+                step=ts.step + 1,
+            )
+
+        def step(ts: TrainState, images, labels):
+            images, labels = self.shard_batch(images, labels)
+            stacked_grads, stacked_state, stacked_metrics = grad_fn(ts, images, labels)
+            jax.block_until_ready(stacked_grads)
+            if (
+                self.bottleneck_rank is not None
+                and process_index() == self.bottleneck_rank % max(jax.process_count(), 1)
+            ):
+                # Straggler injection: this host enters the collective late
+                # (reference: time.sleep(bottle_neck_delay) on one rank,
+                # model-mp.py:47,64-65). In synchronous SPMD the whole step
+                # inherits the delay — the effect task2 asks students to
+                # observe (sections/checking.tex:22).
+                time.sleep(self.bottleneck_delay_s)
+            t0 = time.perf_counter()
+            grads, model_state = agg_fn(stacked_grads, stacked_state)
+            jax.block_until_ready(grads)
+            self.comm_stats.add(time.perf_counter() - t0)
+            new_ts = apply_fn(ts, grads, model_state)
+            metrics = {
+                "loss": jnp.mean(stacked_metrics["loss"]),
+                "accuracy": jnp.mean(stacked_metrics["accuracy"]),
+            }
+            return new_ts, metrics
+
+        return step
+
+
+def make_dp_train_step(
+    model: Module,
+    optimizer: Optimizer,
+    mesh: Mesh,
+    axis_name: str = "data",
+    aggregation: str = "allreduce",
+    rng_root: jax.Array | None = None,
+) -> Callable:
+    """Functional shortcut for the fused DP step."""
+    return DataParallel(
+        model, optimizer, mesh, axis_name, aggregation, rng_root=rng_root
+    ).make_train_step()

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass
-from functools import partial
+from functools import lru_cache, partial
 from typing import Any, Callable
 
 import jax
@@ -86,7 +86,12 @@ def make_train_step(
     return step
 
 
+@lru_cache(maxsize=64)
 def make_eval_step(model: Module) -> Callable:
+    """Cached per-model (Modules are frozen dataclasses, hence hashable), so
+    repeated ``evaluate`` calls reuse one compiled program instead of
+    re-jitting every epoch."""
+
     @jax.jit
     def step(params, model_state, images, labels):
         logits, _ = model.apply(params, model_state, images, train=False)
@@ -136,7 +141,7 @@ def train_loop(
         for images, labels in train_loader:
             ts, metrics = step(ts, images, labels)
             counter += 1
-            if counter % log_every == 0:
+            if log_every and counter % log_every == 0:
                 loss = float(metrics["loss"])
                 if writer is not None:
                     writer.add_scalar("Train Loss", loss, counter)
