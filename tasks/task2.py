@@ -49,10 +49,11 @@ def reference_defaults() -> TrainConfig:
 
 def run(cfg: TrainConfig) -> dict:
     distributed_init(cfg.dist)
-    n = cfg.dist.num_processes if cfg.dist.num_processes > 1 else None
+    n = cfg.dist.num_processes if cfg.dist.explicit_world else None
     devices = jax.devices()
     if n is not None and n <= len(devices) and jax.process_count() == 1:
         devices = devices[:n]  # --n_devices on one host: use first n chips
+        # (--n_devices 1 ⇒ the single-machine baseline of task3.tex:23)
     mesh = make_mesh(MeshConfig({"data": len(devices)}), devices)
     world = mesh.shape["data"]
 

@@ -1,0 +1,116 @@
+"""GSPMD model parallelism: sharding placement + single-device parity.
+
+The task4 parity contract (SURVEY.md §7): observable equivalence = loss
+curves match single-device training; mechanism = params sharded over the
+``stage`` axis with optimizer state colocated (DistributedOptimizer
+analogue).
+"""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tpudml.core.config import MeshConfig
+from tpudml.core.dist import make_mesh
+from tpudml.core.prng import seed_key
+from tpudml.data.datasets import synthetic_classification
+from tpudml.models import lenet_stages
+from tpudml.optim import make_optimizer
+from tpudml.parallel.mp import GSPMDParallel, apply_rules, stage_sharding_rules
+from tpudml.train import TrainState, make_train_step
+
+
+@pytest.fixture(scope="module")
+def batch():
+    images, labels = synthetic_classification(32, (28, 28, 1), 10, seed=11)
+    return np.asarray(images), np.asarray(labels)
+
+
+def test_rules_shard_output_dims_and_demote_indivisible():
+    mesh = make_mesh(MeshConfig({"stage": 8}))
+    model = lenet_stages()
+    params, _ = model.init(seed_key(0))
+    specs = apply_rules(stage_sharding_rules(), params, mesh)
+    # fc Dense(400,120): out=120 divisible by 8 -> sharded.
+    assert specs["fc"]["layer0"]["kernel"] == P(None, "stage")
+    # conv layer0 Conv2D(1,6): out-channels 6 NOT divisible by 8 -> demoted.
+    assert specs["conv"]["layer0"]["kernel"] == P(None, None, None, None)
+    # final Dense(120,10): out=10 not divisible -> demoted.
+    assert specs["fc"]["layer2"]["kernel"] == P(None, None)
+
+
+def test_mp_matches_single_device(batch):
+    mesh = make_mesh(MeshConfig({"stage": 2}), jax.devices()[:2])
+    model = lenet_stages()
+    opt = make_optimizer("sgd", 0.01)
+
+    mp = GSPMDParallel(model, opt, mesh)
+    ts_mp = mp.create_state(seed_key(0))
+    step_mp = mp.make_train_step()
+
+    ts_1 = TrainState.create(model, opt, seed_key(0))
+    step_1 = make_train_step(model, opt)
+
+    losses_mp, losses_1 = [], []
+    for _ in range(3):
+        ts_mp, m = step_mp(ts_mp, *batch)
+        losses_mp.append(float(m["loss"]))
+        ts_1, m1 = step_1(ts_1, *batch)
+        losses_1.append(float(m1["loss"]))
+    np.testing.assert_allclose(losses_mp, losses_1, rtol=1e-4)
+    for a, b in zip(jax.tree.leaves(ts_mp.params), jax.tree.leaves(ts_1.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
+
+
+def test_mp_params_actually_sharded(batch):
+    mesh = make_mesh(MeshConfig({"stage": 2}), jax.devices()[:2])
+    model = lenet_stages()
+    opt = make_optimizer("sgd", 0.01, momentum=0.9)  # momentum state shards too
+    mp = GSPMDParallel(model, opt, mesh)
+    ts = mp.create_state(seed_key(0))
+    kernel = ts.params["fc"]["layer0"]["kernel"]  # Dense(400,120)
+    assert kernel.sharding.spec == P(None, "stage")
+    # One shard per device, half the columns each.
+    shards = kernel.addressable_shards
+    assert len(shards) == 2
+    assert shards[0].data.shape == (400, 60)
+    # Optimizer momentum buffer colocated with its parameter.
+    buf = ts.opt_state["fc"]["layer0"]["kernel"]
+    assert buf.sharding.spec == P(None, "stage")
+
+
+def test_mp_composes_with_dp(batch):
+    mesh = make_mesh(MeshConfig({"data": 4, "stage": 2}))
+    model = lenet_stages()
+    opt = make_optimizer("sgd", 0.01)
+    mp = GSPMDParallel(model, opt, mesh, batch_axis="data")
+    ts = mp.create_state(seed_key(0))
+    step = mp.make_train_step()
+
+    ts_1 = TrainState.create(model, opt, seed_key(0))
+    step_1 = make_train_step(model, opt)
+
+    losses, losses_1 = [], []
+    for _ in range(2):
+        ts, m = step(ts, *batch)
+        losses.append(float(m["loss"]))
+        ts_1, m1 = step_1(ts_1, *batch)
+        losses_1.append(float(m1["loss"]))
+    np.testing.assert_allclose(losses, losses_1, rtol=1e-4)
+
+
+def test_task4_end_to_end(tmp_path):
+    import tasks.task4 as task4
+
+    cfg = task4.reference_defaults()
+    cfg.epochs = 2
+    cfg.lr = 0.05
+    cfg.momentum = 0.9
+    cfg.log_every = 0
+    cfg.log_dir = str(tmp_path / "logs")
+    cfg.data.dataset = "synthetic"
+    cfg.data.batch_size = 32
+    metrics = task4.run(cfg)
+    assert metrics["world"] == 8
+    assert metrics["test_accuracy"] > 0.5

@@ -30,6 +30,18 @@ def test_task2_end_to_end(tmp_path, aggregation):
     assert metrics["loss"] < 2.3
 
 
+def test_task2_n_devices_1_is_single_machine_baseline(tmp_path):
+    """--n_devices 1 must run on ONE device (task3.tex:23's single-machine
+    comparison), not silently use the whole mesh."""
+    cfg = small_cfg(tmp_path)
+    cfg.epochs = 1
+    cfg.data.batch_size = 64
+    cfg.dist.num_processes = 1
+    cfg.dist.explicit_world = True
+    metrics = task2.run(cfg)
+    assert metrics["world"] == 1
+
+
 def test_task2_measure_comm_and_bottleneck(tmp_path):
     cfg = small_cfg(tmp_path, measure_comm=True, bottleneck_rank=0)
     cfg.bottleneck_delay_s = 0.01

@@ -35,6 +35,11 @@ class DistributedConfig:
     process_id: int = 0
     backend: str | None = None  # None = autodetect platform
     initialize_timeout_s: int = 300
+    # True when the world size was given explicitly (--n_devices / env), so
+    # single-host runs can distinguish "--n_devices 1" (use ONE device — the
+    # single-machine baseline of sections/task3.tex:23) from the default
+    # "use every available device".
+    explicit_world: bool = False
 
     @classmethod
     def from_env(cls) -> "DistributedConfig":
@@ -50,13 +55,15 @@ class DistributedConfig:
             port = os.environ.get("MASTER_PORT")
             if addr and port:
                 coord = f"{addr}:{port}"
+        nproc = os.environ.get(
+            "TPUDML_NUM_PROCESSES", os.environ.get("WORLD_SIZE")
+        )
         return cls(
             coordinator_address=coord,
-            num_processes=int(
-                os.environ.get("TPUDML_NUM_PROCESSES", os.environ.get("WORLD_SIZE", "1"))
-            ),
+            num_processes=int(nproc) if nproc is not None else 1,
             process_id=int(os.environ.get("TPUDML_PROCESS_ID", os.environ.get("RANK", "0"))),
             backend=os.environ.get("TPUDML_BACKEND"),
+            explicit_world=nproc is not None,
         )
 
 
@@ -176,6 +183,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
     cfg.dist = DistributedConfig.from_env()
     if getattr(args, "n_devices", None) is not None:
         cfg.dist.num_processes = args.n_devices
+        cfg.dist.explicit_world = True
     if getattr(args, "rank", None) is not None:
         cfg.dist.process_id = args.rank
     if getattr(args, "master_addr", None) is not None and getattr(args, "master_port", None):

@@ -37,6 +37,17 @@ class Optimizer:
     def init(self, params: PyTree) -> PyTree:
         return ()
 
+    def init_spec(self, param_specs: PyTree) -> PyTree:
+        """Mirror of ``init`` over PartitionSpecs: given the sharding specs
+        of ``params``, return the specs of the optimizer state. Because the
+        reference's DistributedOptimizer updates parameters where they live
+        (via RRefs, codes/task4/model.py:126), the TPU-native analogue is
+        optimizer state sharded IDENTICALLY to its parameters — updates then
+        happen on the owning devices by construction (SURVEY.md §2.3
+        parameter-server row; this is also ZeRO-style state sharding).
+        """
+        return ()
+
     def update(self, grads: PyTree, state: PyTree, params: PyTree) -> tuple[PyTree, PyTree]:
         raise NotImplementedError
 
@@ -72,6 +83,11 @@ class Sgd(Optimizer):
             return ()
         return jax.tree.map(jnp.zeros_like, params)
 
+    def init_spec(self, param_specs):
+        if self.momentum == 0.0:
+            return ()
+        return param_specs
+
     def update(self, grads, state, params):
         if self.momentum == 0.0:
             return jax.tree.map(lambda p, g: p - self.lr * g, params, grads), state
@@ -92,6 +108,11 @@ class Adam(Optimizer):
     def init(self, params):
         zeros = lambda: jax.tree.map(jnp.zeros_like, params)
         return {"m": zeros(), "v": zeros(), "t": jnp.zeros((), jnp.int32)}
+
+    def init_spec(self, param_specs):
+        from jax.sharding import PartitionSpec
+
+        return {"m": param_specs, "v": param_specs, "t": PartitionSpec()}
 
     def update(self, grads, state, params):
         t = state["t"] + 1
@@ -129,6 +150,9 @@ class ReferenceAdam(Optimizer):
     def init(self, params):
         zeros = lambda: jax.tree.map(jnp.zeros_like, params)
         return {"m": zeros(), "v": zeros()}
+
+    def init_spec(self, param_specs):
+        return {"m": param_specs, "v": param_specs}
 
     def update(self, grads, state, params):
         m = jax.tree.map(lambda m_, g: self.b1 * m_ + (1 - self.b1) * g, state["m"], grads)

@@ -16,10 +16,14 @@ from tpudml.parallel.sharding import (
     shard_map_fn,
 )
 from tpudml.parallel.dp import DataParallel, make_dp_train_step
+from tpudml.parallel.mp import GSPMDParallel, apply_rules, stage_sharding_rules
 
 __all__ = [
     "DataParallel",
+    "GSPMDParallel",
     "make_dp_train_step",
+    "apply_rules",
+    "stage_sharding_rules",
     "data_sharding",
     "replicate",
     "replicated_sharding",

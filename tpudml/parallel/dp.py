@@ -81,6 +81,9 @@ class DataParallel:
         self.comm_stats = CommStats()
         self.world = mesh.shape[axis_name]
         self._loss_fn = make_loss_fn(model)
+        # See GSPMDParallel: XLA:CPU's collective rendezvous aborts under
+        # a deep async queue of collective programs; serialize on CPU sim.
+        self._sync_each_step = all(d.platform == "cpu" for d in mesh.devices.flat)
 
     # ---------------------------------------------------------------- state
 
@@ -173,7 +176,10 @@ class DataParallel:
 
         def step(ts: TrainState, images, labels):
             images, labels = self.shard_batch(images, labels)
-            return jitted(ts, images, labels)
+            out = jitted(ts, images, labels)
+            if self._sync_each_step:
+                jax.block_until_ready(out[1]["loss"])
+            return out
 
         return step
 

@@ -1,0 +1,249 @@
+"""GSPMD model parallelism: parameters sharded over a mesh axis, one
+jitted step, XLA inserts the inter-device transfers.
+
+Re-design of the reference's task4 RPC model parallelism (codes/task4/
+model.py): there, LeNet is split into SubNetConv/SubNetFC living in other
+processes, every forward is two blocking RPC round-trips shipping
+activations (model.py:57-60), gradients flow through ``dist_autograd`` and
+a ``DistributedOptimizer`` steps parameters where they live via RRefs
+(model.py:75-84,126). Here the SAME observable contract — model weights
+split across devices, activations moving between them, gradient computation
+and optimizer updates happening where each parameter lives — is expressed
+as sharding annotations on ONE jitted program: a rule maps each parameter
+leaf to a PartitionSpec over the ``stage`` axis, optimizer state inherits
+its parameter's spec (the DistributedOptimizer/parameter-server analogue,
+also ZeRO-style state sharding), and the XLA SPMD partitioner schedules the
+activation collectives on ICI that the reference performed with rpc_sync.
+
+Note on naming: the reference's checklist calls this split "horizontal"
+while task4's prose calls the layer split "vertical" (SURVEY.md §2.2). The
+GSPMD rule here shards each layer's output features/channels across the
+axis — the intra-layer (tensor-parallel flavored) split; the inter-layer
+pipelined split is a separate engine (micro-batched pipeline over stacked
+stages). Parity is defined by
+loss-curve equivalence to single-device training (SURVEY.md §7), which
+tests assert for both.
+
+Composable with data parallelism: pass ``batch_axis="data"`` on a 2-D
+mesh {"data": D, "stage": S} and the batch shards over ``data`` while
+params shard over ``stage`` — GSPMD derives the gradient psum over the
+data axis automatically (no explicit collective code).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpudml.nn.layers import Module
+from tpudml.nn.losses import accuracy
+from tpudml.optim import Optimizer
+from tpudml.train import TrainState, make_loss_fn
+
+PyTree = Any
+
+RuleFn = Callable[[tuple, jax.ShapeDtypeStruct], P]
+
+
+def stage_sharding_rules(axis_name: str = "stage") -> RuleFn:
+    """Default rule: shard each weight's OUTPUT dimension over the axis.
+
+    kernel[in, out] -> P(None, axis); conv kernel[h, w, in, out] ->
+    P(None, None, None, axis); bias[out] -> P(axis). Leaves whose output
+    dim does not divide the axis size fall back to replicated at placement
+    time (see :func:`apply_rules`).
+    """
+
+    def rule(path: tuple, leaf) -> P:
+        name = path[-1] if path else ""
+        if name == "kernel" and leaf.ndim == 2:
+            return P(None, axis_name)
+        if name == "kernel" and leaf.ndim == 4:
+            return P(None, None, None, axis_name)
+        if name == "bias" and leaf.ndim == 1:
+            return P(axis_name)
+        return P()
+
+    return rule
+
+
+def replicated_rules() -> RuleFn:
+    return lambda path, leaf: P()
+
+
+def _path_names(key_path) -> tuple:
+    names = []
+    for k in key_path:
+        names.append(
+            getattr(k, "key", getattr(k, "name", getattr(k, "idx", str(k))))
+        )
+    return tuple(names)
+
+
+def apply_rules(rule: RuleFn, params: PyTree, mesh: Mesh) -> PyTree:
+    """Per-leaf PartitionSpec tree, demoting specs that don't tile evenly.
+
+    A spec naming mesh axes whose product doesn't divide the corresponding
+    leaf dimension is demoted to replicated on that dimension — the
+    framework-level guarantee that any model works on any mesh (degenerate
+    placements are correct, just less parallel).
+    """
+
+    def leaf_spec(key_path, leaf):
+        spec = rule(_path_names(key_path), leaf)
+        out = []
+        for dim, names in enumerate(spec):
+            if names is None:
+                out.append(None)
+                continue
+            axis_tuple = names if isinstance(names, tuple) else (names,)
+            size = 1
+            for a in axis_tuple:
+                size *= mesh.shape[a]
+            out.append(names if leaf.shape[dim] % size == 0 else None)
+        return P(*out)
+
+    return jax.tree_util.tree_map_with_path(leaf_spec, params)
+
+
+class GSPMDParallel:
+    """Model-(+data-)parallel training engine driven by sharding rules.
+
+    Usage::
+
+        mp = GSPMDParallel(model, opt, mesh)           # mesh {"stage": S}
+        ts = mp.create_state(key)                      # params sharded
+        step = mp.make_train_step()                    # one jitted program
+
+    With a 2-D mesh and ``batch_axis="data"``, DP composes in for free.
+    """
+
+    def __init__(
+        self,
+        model: Module,
+        optimizer: Optimizer,
+        mesh: Mesh,
+        rule: RuleFn | None = None,
+        axis_name: str = "stage",
+        batch_axis: str | None = None,
+        rng_root: jax.Array | None = None,
+    ):
+        self.model = model
+        self.optimizer = optimizer
+        self.mesh = mesh
+        self.axis_name = axis_name
+        if batch_axis is not None and batch_axis not in mesh.shape:
+            raise ValueError(
+                f"batch_axis {batch_axis!r} not in mesh axes {tuple(mesh.shape)}"
+            )
+        self.batch_axis = batch_axis
+        self.rule = rule or stage_sharding_rules(axis_name)
+        self.rng_root = rng_root
+        self._loss_fn = make_loss_fn(model)
+        self._specs = None  # computed at create_state
+        # XLA:CPU's collective rendezvous deadlocks (and then aborts the
+        # process) when many in-flight partitioned programs oversubscribe
+        # the host thread pool — seen with >~50 async-queued steps on a
+        # 1-core box. Serialize dispatch on the simulated-CPU backend;
+        # real TPU keeps full async pipelining.
+        self._sync_each_step = all(d.platform == "cpu" for d in mesh.devices.flat)
+
+    # ---------------------------------------------------------------- state
+
+    def state_specs(self, ts: TrainState) -> TrainState:
+        """PartitionSpec tree for the whole TrainState."""
+        param_specs = apply_rules(self.rule, ts.params, self.mesh)
+        # model_state (e.g. BN stats) follows the same rule; opt state
+        # mirrors its parameters (parameter-server semantic, see
+        # Optimizer.init_spec).
+        state_specs = apply_rules(self.rule, ts.model_state, self.mesh)
+        opt_specs = self.optimizer.init_spec(param_specs)
+        return TrainState(
+            params=param_specs,
+            model_state=state_specs,
+            opt_state=opt_specs,
+            step=P(),
+        )
+
+    def _shardings(self, spec_tree):
+        return jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s),
+            spec_tree,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+
+    def create_state(self, key: jax.Array) -> TrainState:
+        ts = TrainState.create(self.model, self.optimizer, key)
+        self._specs = self.state_specs(ts)
+        return jax.device_put(ts, self._shardings(self._specs))
+
+    # ----------------------------------------------------------------- step
+
+    def make_train_step(self) -> Callable:
+        if self._specs is None:
+            raise RuntimeError("call create_state() before make_train_step()")
+        batch_spec = P(self.batch_axis) if self.batch_axis else P()
+        state_shardings = self._shardings(self._specs)
+        batch_sharding = NamedSharding(self.mesh, batch_spec)
+
+        def step_impl(ts: TrainState, images, labels):
+            rng = None
+            if self.rng_root is not None:
+                rng = jax.random.fold_in(self.rng_root, ts.step)
+            (loss, (model_state, logits)), grads = jax.value_and_grad(
+                self._loss_fn, has_aux=True
+            )(ts.params, ts.model_state, images, labels, rng)
+            new_params, new_opt = self.optimizer.update(grads, ts.opt_state, ts.params)
+            new_ts = TrainState(
+                params=new_params,
+                model_state=model_state,
+                opt_state=new_opt,
+                step=ts.step + 1,
+            )
+            return new_ts, {"loss": loss, "accuracy": accuracy(logits, labels)}
+
+        jitted = jax.jit(
+            step_impl,
+            in_shardings=(state_shardings, batch_sharding, batch_sharding),
+            out_shardings=(state_shardings, None),
+        )
+
+        def step(ts: TrainState, images, labels):
+            images = jax.device_put(jnp.asarray(images), batch_sharding)
+            labels = jax.device_put(jnp.asarray(labels), batch_sharding)
+            out = jitted(ts, images, labels)
+            if self._sync_each_step:
+                jax.block_until_ready(out[1]["loss"])
+            return out
+
+        return step
+
+    # ------------------------------------------------------------- evaluate
+
+    def make_eval_step(self) -> Callable:
+        if self._specs is None:
+            raise RuntimeError("call create_state() before make_eval_step()")
+        param_shardings = self._shardings(self._specs.params)
+        state_shardings = self._shardings(self._specs.model_state)
+        batch_sharding = NamedSharding(
+            self.mesh, P(self.batch_axis) if self.batch_axis else P()
+        )
+
+        def eval_impl(params, model_state, images, labels):
+            logits, _ = self.model.apply(params, model_state, images, train=False)
+            return jnp.sum((jnp.argmax(logits, -1) == labels).astype(jnp.int32))
+
+        jitted = jax.jit(
+            eval_impl,
+            in_shardings=(param_shardings, state_shardings, batch_sharding, batch_sharding),
+        )
+
+        def step(params, model_state, images, labels):
+            images = jax.device_put(jnp.asarray(images), batch_sharding)
+            labels = jax.device_put(jnp.asarray(labels), batch_sharding)
+            return jitted(params, model_state, images, labels)
+
+        return step
