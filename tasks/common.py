@@ -1,0 +1,36 @@
+"""Scaffolding shared by the task entrypoints (device selection, dataset
+splits) so launch semantics can't silently diverge between tasks."""
+
+from __future__ import annotations
+
+import jax
+
+from tpudml.core.config import TrainConfig
+from tpudml.data import load_dataset
+
+
+def select_devices(cfg: TrainConfig) -> list:
+    """Visible devices, honoring --n_devices on a single host.
+
+    ``--n_devices N`` on one host uses the first N chips (``--n_devices 1``
+    is the single-machine baseline of sections/task3.tex:23); in multi-process
+    runs the world size is fixed by the launcher, so the flag is ignored.
+    """
+    devices = jax.devices()
+    n = cfg.dist.num_processes if cfg.dist.explicit_world else None
+    if n is not None and n <= len(devices) and jax.process_count() == 1:
+        devices = devices[:n]
+    return devices
+
+
+def load_splits(cfg: TrainConfig):
+    """(train, test) ArrayDatasets per the config's dataset selection."""
+    train_set = load_dataset(
+        cfg.data.dataset, cfg.data.data_dir, "train",
+        synthetic_fallback=cfg.data.synthetic_fallback,
+    )
+    test_set = load_dataset(
+        cfg.data.dataset, cfg.data.data_dir, "test",
+        synthetic_fallback=cfg.data.synthetic_fallback,
+    )
+    return train_set, test_set

@@ -1,0 +1,116 @@
+"""North-star workload — CIFAR-10 ResNet-18 data-parallel training.
+
+BASELINE.json's headline metric: "CIFAR-10 ResNet-18 DDP: imgs/sec/chip +
+val-acc parity vs 2xGPU NCCL". The reference repo itself contains no ResNet
+code (SURVEY.md §6) — the workload is driver-defined; this entrypoint is the
+measurement vehicle.
+
+TPU-first: bfloat16 compute (MXU), NHWC, one fused SPMD step over the mesh
+``data`` axis, synchronous gradient psum-mean (same engine as tasks/task2).
+
+Run: ``python -m tasks.north_star [--epochs 10] [--batch_size 128]
+[--n_devices N] [--f32]``
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax.numpy as jnp
+
+from tasks.common import load_splits, select_devices
+from tpudml.core.config import MeshConfig, TrainConfig, build_parser, config_from_args
+from tpudml.core.dist import distributed_init, make_mesh
+from tpudml.core.prng import seed_key
+from tpudml.data import DataLoader, ShardedDataLoader
+from tpudml.data.sampler import make_sampler
+from tpudml.metrics import MetricsWriter
+from tpudml.models import ResNet18
+from tpudml.optim import make_optimizer
+from tpudml.parallel.dp import DataParallel
+from tpudml.train import evaluate, train_loop
+
+
+def reference_defaults() -> TrainConfig:
+    cfg = TrainConfig()
+    cfg.epochs = 10
+    cfg.optimizer = "sgd"
+    cfg.lr = 0.1
+    cfg.momentum = 0.9
+    cfg.data.dataset = "cifar10"
+    cfg.data.batch_size = 128  # per-replica
+    return cfg
+
+
+def run(cfg: TrainConfig, compute_dtype=jnp.bfloat16) -> dict:
+    distributed_init(cfg.dist)
+    devices = select_devices(cfg)
+    mesh = make_mesh(MeshConfig({"data": len(devices)}), devices)
+    world = mesh.shape["data"]
+
+    train_set, test_set = load_splits(cfg)
+
+    samplers = [
+        make_sampler(
+            cfg.data.division, len(train_set), world, r,
+            shuffle=cfg.data.shuffle, seed=cfg.data.seed,
+        )
+        for r in range(world)
+    ]
+    train_loader = ShardedDataLoader(
+        train_set, cfg.data.batch_size, samplers,
+        drop_remainder=cfg.data.drop_remainder,
+    )
+    test_loader = DataLoader(test_set, cfg.data.batch_size, drop_remainder=False)
+
+    model = ResNet18(
+        compute_dtype=compute_dtype, in_channels=train_set.images.shape[-1]
+    )
+    optimizer = make_optimizer(cfg.optimizer, cfg.lr, cfg.momentum)
+    dp = DataParallel(model, optimizer, mesh)
+    ts = dp.create_state(seed_key(cfg.seed))
+    step = dp.make_train_step()
+
+    writer = MetricsWriter(cfg.log_dir, run_name=f"north-star-w{world}")
+    t0 = time.time()
+    ts, metrics = train_loop(
+        model,
+        optimizer,
+        train_loader,
+        cfg.epochs,
+        seed_key(cfg.seed),
+        writer=writer,
+        log_every=cfg.log_every,
+        step_fn=step,
+        state=ts,
+    )
+    train_time = time.time() - t0
+    global_batch = cfg.data.batch_size * world
+    imgs_per_sec = global_batch * metrics["steps"] / train_time
+    metrics["imgs_per_sec_per_chip"] = imgs_per_sec / world
+
+    acc = evaluate(model, ts, test_loader)
+    print(
+        f"Test accuracy: {acc * 100:.2f}% | "
+        f"{metrics['imgs_per_sec_per_chip']:.1f} imgs/sec/chip"
+    )
+    writer.add_scalar("Test Accuracy", acc, int(ts.step))
+    writer.add_scalar("Imgs/sec/chip", metrics["imgs_per_sec_per_chip"], int(ts.step))
+    writer.close()
+    metrics["test_accuracy"] = acc
+    metrics["world"] = world
+    return metrics
+
+
+def main(argv=None):
+    parser = build_parser(reference_defaults())
+    parser.add_argument(
+        "--f32", action="store_true", help="disable bf16 compute (numerics A/B)"
+    )
+    args = parser.parse_args(argv)
+    cfg = config_from_args(args)
+    return run(cfg, compute_dtype=jnp.float32 if args.f32 else jnp.bfloat16)
+
+
+if __name__ == "__main__":
+    main()

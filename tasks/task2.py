@@ -23,12 +23,12 @@ Run: ``python -m tasks.task2 [--aggregation allgather] [--measure_comm]
 
 from __future__ import annotations
 
-import jax
 
+from tasks.common import load_splits, select_devices
 from tpudml.core.config import MeshConfig, TrainConfig, build_parser, config_from_args
 from tpudml.core.dist import distributed_init, make_mesh
 from tpudml.core.prng import seed_key
-from tpudml.data import DataLoader, ShardedDataLoader, load_dataset
+from tpudml.data import DataLoader, ShardedDataLoader
 from tpudml.data.sampler import make_sampler
 from tpudml.metrics import MetricsWriter
 from tpudml.models import LeNet
@@ -49,22 +49,11 @@ def reference_defaults() -> TrainConfig:
 
 def run(cfg: TrainConfig) -> dict:
     distributed_init(cfg.dist)
-    n = cfg.dist.num_processes if cfg.dist.explicit_world else None
-    devices = jax.devices()
-    if n is not None and n <= len(devices) and jax.process_count() == 1:
-        devices = devices[:n]  # --n_devices on one host: use first n chips
-        # (--n_devices 1 ⇒ the single-machine baseline of task3.tex:23)
+    devices = select_devices(cfg)
     mesh = make_mesh(MeshConfig({"data": len(devices)}), devices)
     world = mesh.shape["data"]
 
-    train_set = load_dataset(
-        cfg.data.dataset, cfg.data.data_dir, "train",
-        synthetic_fallback=cfg.data.synthetic_fallback,
-    )
-    test_set = load_dataset(
-        cfg.data.dataset, cfg.data.data_dir, "test",
-        synthetic_fallback=cfg.data.synthetic_fallback,
-    )
+    train_set, test_set = load_splits(cfg)
 
     # DistributedSampler parity (reference model.py:124): random partition,
     # one sampler per mesh replica, per-epoch reshuffle via set_epoch.

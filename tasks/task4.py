@@ -23,12 +23,12 @@ simulated meshes, or TPU slices.)
 
 from __future__ import annotations
 
-import jax
 
+from tasks.common import load_splits, select_devices
 from tpudml.core.config import MeshConfig, TrainConfig, build_parser, config_from_args
 from tpudml.core.dist import distributed_init, make_mesh
 from tpudml.core.prng import seed_key
-from tpudml.data import DataLoader, load_dataset
+from tpudml.data import DataLoader
 from tpudml.data.sampler import make_sampler
 from tpudml.metrics import MetricsWriter
 from tpudml.models import lenet_stages
@@ -49,21 +49,11 @@ def reference_defaults() -> TrainConfig:
 
 def run(cfg: TrainConfig) -> dict:
     distributed_init(cfg.dist)
-    devices = jax.devices()
-    n = cfg.dist.num_processes if cfg.dist.explicit_world else None
-    if n is not None and n <= len(devices) and jax.process_count() == 1:
-        devices = devices[:n]
+    devices = select_devices(cfg)
     mesh = make_mesh(MeshConfig({"stage": len(devices)}), devices)
     world = mesh.shape["stage"]
 
-    train_set = load_dataset(
-        cfg.data.dataset, cfg.data.data_dir, "train",
-        synthetic_fallback=cfg.data.synthetic_fallback,
-    )
-    test_set = load_dataset(
-        cfg.data.dataset, cfg.data.data_dir, "test",
-        synthetic_fallback=cfg.data.synthetic_fallback,
-    )
+    train_set, test_set = load_splits(cfg)
     # Data enters on the host like the reference's rank-0-only loading
     # (model.py:117-124); batches are replicated across stage devices.
     sampler = make_sampler(

@@ -1,0 +1,83 @@
+"""ResNet-18 north-star model: shapes, learning, bf16 path, DP parity."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpudml.core.config import MeshConfig
+from tpudml.core.dist import make_mesh
+from tpudml.core.prng import seed_key
+from tpudml.data.datasets import synthetic_classification
+from tpudml.models import ResNet18
+from tpudml.optim import make_optimizer
+from tpudml.parallel.dp import DataParallel
+from tpudml.train import TrainState, make_train_step
+
+
+def small_resnet(**kw):
+    # Narrow 2-stage variant: same code paths (stem, blocks, projection
+    # shortcut, head), ~1000x fewer FLOPs than the full ResNet-18.
+    from tpudml.models.resnet import ResNet
+
+    return ResNet(stage_sizes=(1, 1), width=8, **kw)
+
+
+def test_forward_shape():
+    model = small_resnet()
+    params, state = model.init(seed_key(0))
+    x = jnp.ones((4, 32, 32, 3), jnp.float32)
+    logits, new_state = model.apply(params, state, x, train=True)
+    assert logits.shape == (4, 10)
+    assert logits.dtype == jnp.float32
+    # BN running stats updated in train mode.
+    diff = jax.tree.map(
+        lambda a, b: float(jnp.max(jnp.abs(a - b))), state, new_state
+    )
+    assert max(jax.tree.leaves(diff)) > 0
+
+
+def test_resnet18_structure():
+    model = ResNet18()
+    params, _ = model.init(seed_key(0))
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+    # Canonical CIFAR ResNet-18 parameter count ~11.17M.
+    assert 11_000_000 < n_params < 11_300_000
+
+
+def test_bf16_compute_path():
+    model = small_resnet(compute_dtype=jnp.bfloat16)
+    params, state = model.init(seed_key(0))
+    # Params stay float32 (master copy).
+    assert all(p.dtype == jnp.float32 for p in jax.tree.leaves(params))
+    x = jnp.ones((2, 32, 32, 3), jnp.float32)
+    logits, _ = model.apply(params, state, x, train=False)
+    assert logits.dtype == jnp.float32
+    # bf16 and f32 paths agree loosely.
+    ref, _ = small_resnet().apply(params, state, x, train=False)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref), atol=0.15)
+
+
+def test_learns_synthetic_cifar():
+    model = small_resnet()
+    opt = make_optimizer("sgd", 0.05, momentum=0.9)
+    images, labels = synthetic_classification(128, (32, 32, 3), 10, seed=0)
+    images, labels = jnp.asarray(images), jnp.asarray(labels)
+    step = make_train_step(model, opt)
+    ts = TrainState.create(model, opt, seed_key(0))
+    _, m0 = step(ts, images, labels)
+    for _ in range(15):
+        ts, m = step(ts, images, labels)
+    assert float(m["loss"]) < float(m0["loss"])
+
+
+def test_dp_resnet_runs():
+    mesh = make_mesh(MeshConfig(axes={"data": 4}), jax.devices()[:4])
+    model = small_resnet()
+    opt = make_optimizer("sgd", 0.05, momentum=0.9)
+    dp = DataParallel(model, opt, mesh)
+    ts = dp.create_state(seed_key(0))
+    step = dp.make_train_step()
+    images, labels = synthetic_classification(32, (32, 32, 3), 10, seed=0)
+    ts, metrics = step(ts, images, labels)
+    assert np.isfinite(float(metrics["loss"]))
+    assert int(ts.step) == 1

@@ -1,5 +1,14 @@
 from tpudml.models.lenet import LeNet
 from tpudml.models.mlp import ForwardMLP
+from tpudml.models.resnet import ResNet, ResNet18, ResNet34
 from tpudml.models.staged import StagedModel, lenet_stages
 
-__all__ = ["LeNet", "ForwardMLP", "StagedModel", "lenet_stages"]
+__all__ = [
+    "LeNet",
+    "ForwardMLP",
+    "ResNet",
+    "ResNet18",
+    "ResNet34",
+    "StagedModel",
+    "lenet_stages",
+]

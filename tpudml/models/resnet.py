@@ -1,0 +1,191 @@
+"""ResNet for CIFAR — the north-star model (BASELINE.json `configs`:
+"task1: single-process ResNet-18 on CIFAR-10"; headline metric "CIFAR-10
+ResNet-18 DDP: imgs/sec/chip").
+
+TPU-first design decisions (not in the reference, which has no ResNet code —
+only the metric definition):
+
+- **NHWC layout** end-to-end — XLA:TPU's preferred convolution layout.
+- **bfloat16 compute path**: parameters live in float32 (master copy; the
+  optimizer update stays full-precision), activations and conv/dense kernels
+  are cast to ``compute_dtype`` inside ``apply`` so the matmuls/convs hit the
+  MXU at bf16 throughput. Batch-norm statistics are always computed in
+  float32 — bf16 mean/var is numerically unstable at CIFAR batch sizes.
+- CIFAR stem (3x3 stride-1 conv, no max-pool) for 32x32 inputs; ImageNet
+  stem (7x7 stride-2 + 3x3 max-pool) selectable via ``stem="imagenet"``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from tpudml.nn.layers import BatchNorm, Conv2D, Dense, Module
+
+
+def _cast(tree, dtype):
+    return jax.tree.map(lambda a: a.astype(dtype), tree)
+
+
+@dataclass(frozen=True)
+class BasicBlock(Module):
+    """Two 3x3 convs + identity/projection shortcut (ResNet-18/34 block)."""
+
+    in_channels: int
+    out_channels: int
+    stride: int = 1
+    compute_dtype: Any = jnp.float32
+
+    @property
+    def has_projection(self) -> bool:
+        return self.stride != 1 or self.in_channels != self.out_channels
+
+    def _layers(self):
+        conv1 = Conv2D(
+            self.in_channels, self.out_channels, 3, self.stride, "SAME", use_bias=False
+        )
+        conv2 = Conv2D(self.out_channels, self.out_channels, 3, 1, "SAME", use_bias=False)
+        bn1 = BatchNorm(self.out_channels)
+        bn2 = BatchNorm(self.out_channels)
+        proj = (
+            Conv2D(self.in_channels, self.out_channels, 1, self.stride, "SAME", use_bias=False)
+            if self.has_projection
+            else None
+        )
+        return conv1, bn1, conv2, bn2, proj
+
+    def init(self, key):
+        conv1, bn1, conv2, bn2, proj = self._layers()
+        k1, k2, k3, k4, k5 = jax.random.split(key, 5)
+        params = {
+            "conv1": conv1.init(k1)[0],
+            "conv2": conv2.init(k2)[0],
+            "bn1": bn1.init(k3)[0],
+            "bn2": bn2.init(k4)[0],
+        }
+        state = {"bn1": bn1.init(k3)[1], "bn2": bn2.init(k4)[1]}
+        if proj is not None:
+            params["proj"] = proj.init(k5)[0]
+            pbn = BatchNorm(self.out_channels)
+            params["proj_bn"], state["proj_bn"] = pbn.init(k5)
+        return params, state
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        conv1, bn1, conv2, bn2, proj = self._layers()
+        cdt = self.compute_dtype
+        new_state = {}
+        shortcut = x
+        y, _ = conv1.apply(_cast(params["conv1"], cdt), {}, x)
+        y, new_state["bn1"] = self._bn(bn1, params["bn1"], state["bn1"], y, train)
+        y = jax.nn.relu(y)
+        y, _ = conv2.apply(_cast(params["conv2"], cdt), {}, y)
+        y, new_state["bn2"] = self._bn(bn2, params["bn2"], state["bn2"], y, train)
+        if proj is not None:
+            shortcut, _ = proj.apply(_cast(params["proj"], cdt), {}, x)
+            shortcut, new_state["proj_bn"] = self._bn(
+                BatchNorm(self.out_channels),
+                params["proj_bn"],
+                state["proj_bn"],
+                shortcut,
+                train,
+            )
+        return jax.nn.relu(y + shortcut), new_state
+
+    def _bn(self, bn, params, state, x, train):
+        # BN in float32 regardless of compute dtype, back-cast afterwards.
+        y, new_state = bn.apply(params, state, x.astype(jnp.float32), train=train)
+        return y.astype(self.compute_dtype), new_state
+
+
+@dataclass(frozen=True)
+class ResNet(Module):
+    """Configurable ResNet (basic blocks only — 18/34 class depths)."""
+
+    stage_sizes: tuple[int, ...] = (2, 2, 2, 2)
+    num_classes: int = 10
+    width: int = 64
+    stem: str = "cifar"  # "cifar" (3x3/s1) or "imagenet" (7x7/s2 + pool)
+    in_channels: int = 3
+    compute_dtype: Any = jnp.float32
+
+    def _stem_conv(self):
+        if self.stem == "imagenet":
+            return Conv2D(self.in_channels, self.width, 7, 2, "SAME", use_bias=False)
+        return Conv2D(self.in_channels, self.width, 3, 1, "SAME", use_bias=False)
+
+    def _blocks(self):
+        blocks = []
+        in_ch = self.width
+        for stage, n in enumerate(self.stage_sizes):
+            out_ch = self.width * (2**stage)
+            for i in range(n):
+                stride = 2 if (stage > 0 and i == 0) else 1
+                blocks.append(
+                    BasicBlock(in_ch, out_ch, stride, compute_dtype=self.compute_dtype)
+                )
+                in_ch = out_ch
+        return blocks
+
+    @property
+    def feature_dim(self) -> int:
+        return self.width * (2 ** (len(self.stage_sizes) - 1))
+
+    def init(self, key):
+        stem = self._stem_conv()
+        blocks = self._blocks()
+        head = Dense(self.feature_dim, self.num_classes)
+        keys = jax.random.split(key, len(blocks) + 3)
+        params = {"stem": stem.init(keys[0])[0]}
+        bn = BatchNorm(self.width)
+        params["stem_bn"], stem_bn_state = bn.init(keys[1])
+        state = {"stem_bn": stem_bn_state}
+        for i, (blk, k) in enumerate(zip(blocks, keys[2:-1])):
+            params[f"block{i}"], state[f"block{i}"] = blk.init(k)
+        params["head"] = head.init(keys[-1])[0]
+        return params, state
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        cdt = self.compute_dtype
+        stem = self._stem_conv()
+        blocks = self._blocks()
+        new_state = {}
+        x = x.astype(cdt)
+        y, _ = stem.apply(_cast(params["stem"], cdt), {}, x)
+        bn = BatchNorm(self.width)
+        y, new_state["stem_bn"] = bn.apply(
+            params["stem_bn"], state["stem_bn"], y.astype(jnp.float32), train=train
+        )
+        y = jax.nn.relu(y).astype(cdt)
+        if self.stem == "imagenet":
+            y = jax.lax.reduce_window(
+                y, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME"
+            )
+        for i, blk in enumerate(blocks):
+            y, new_state[f"block{i}"] = blk.apply(
+                params[f"block{i}"], state[f"block{i}"], y, train=train
+            )
+        y = jnp.mean(y, axis=(1, 2))  # global average pool
+        head = Dense(self.feature_dim, self.num_classes)
+        logits, _ = head.apply(_cast(params["head"], cdt), {}, y)
+        return logits.astype(jnp.float32), new_state
+
+
+def ResNet18(num_classes: int = 10, compute_dtype: Any = jnp.float32, **kw) -> ResNet:
+    return ResNet(
+        stage_sizes=(2, 2, 2, 2),
+        num_classes=num_classes,
+        compute_dtype=compute_dtype,
+        **kw,
+    )
+
+
+def ResNet34(num_classes: int = 10, compute_dtype: Any = jnp.float32, **kw) -> ResNet:
+    return ResNet(
+        stage_sizes=(3, 4, 6, 3),
+        num_classes=num_classes,
+        compute_dtype=compute_dtype,
+        **kw,
+    )

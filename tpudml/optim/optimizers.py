@@ -45,7 +45,17 @@ class Optimizer:
         optimizer state sharded IDENTICALLY to its parameters — updates then
         happen on the owning devices by construction (SURVEY.md §2.3
         parameter-server row; this is also ZeRO-style state sharding).
+
+        A subclass that overrides ``init`` (i.e. carries state) MUST also
+        override ``init_spec``; the base fails fast here rather than letting
+        a stateless-spec/stateful-state mismatch surface as an opaque pytree
+        structure error inside ``create_state``.
         """
+        if type(self).init is not Optimizer.init:
+            raise NotImplementedError(
+                f"{type(self).__name__} overrides init() but not init_spec(); "
+                "sharded engines need the optimizer-state spec tree"
+            )
         return ()
 
     def update(self, grads: PyTree, state: PyTree, params: PyTree) -> tuple[PyTree, PyTree]:

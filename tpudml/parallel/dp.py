@@ -36,6 +36,7 @@ from tpudml.optim import Optimizer
 from tpudml.parallel.sharding import (
     data_sharding,
     replicate,
+    serialize_dispatch,
     shard_map_fn,
 )
 from tpudml.train import TrainState, make_loss_fn
@@ -81,9 +82,7 @@ class DataParallel:
         self.comm_stats = CommStats()
         self.world = mesh.shape[axis_name]
         self._loss_fn = make_loss_fn(model)
-        # See GSPMDParallel: XLA:CPU's collective rendezvous aborts under
-        # a deep async queue of collective programs; serialize on CPU sim.
-        self._sync_each_step = all(d.platform == "cpu" for d in mesh.devices.flat)
+        self._sync_each_step = serialize_dispatch(mesh)
 
     # ---------------------------------------------------------------- state
 

@@ -41,6 +41,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from tpudml.nn.layers import Module
 from tpudml.nn.losses import accuracy
 from tpudml.optim import Optimizer
+from tpudml.parallel.sharding import serialize_dispatch
 from tpudml.train import TrainState, make_loss_fn
 
 PyTree = Any
@@ -135,6 +136,10 @@ class GSPMDParallel:
         self.optimizer = optimizer
         self.mesh = mesh
         self.axis_name = axis_name
+        if rule is None and axis_name not in mesh.shape:
+            raise ValueError(
+                f"axis_name {axis_name!r} not in mesh axes {tuple(mesh.shape)}"
+            )
         if batch_axis is not None and batch_axis not in mesh.shape:
             raise ValueError(
                 f"batch_axis {batch_axis!r} not in mesh axes {tuple(mesh.shape)}"
@@ -144,12 +149,7 @@ class GSPMDParallel:
         self.rng_root = rng_root
         self._loss_fn = make_loss_fn(model)
         self._specs = None  # computed at create_state
-        # XLA:CPU's collective rendezvous deadlocks (and then aborts the
-        # process) when many in-flight partitioned programs oversubscribe
-        # the host thread pool — seen with >~50 async-queued steps on a
-        # 1-core box. Serialize dispatch on the simulated-CPU backend;
-        # real TPU keeps full async pipelining.
-        self._sync_each_step = all(d.platform == "cpu" for d in mesh.devices.flat)
+        self._sync_each_step = serialize_dispatch(mesh)
 
     # ---------------------------------------------------------------- state
 

@@ -33,6 +33,17 @@ def shard_map_fn(fn, mesh: Mesh, in_specs, out_specs, check_rep: bool = False):
         )
 
 
+def serialize_dispatch(mesh: Mesh) -> bool:
+    """Whether engines should block on each step's output before dispatching
+    the next. XLA:CPU's collective rendezvous deadlocks (and then aborts the
+    process) when many in-flight partitioned programs oversubscribe the host
+    thread pool — seen with >~50 async-queued steps on a 1-core box. The
+    simulated-CPU mesh therefore serializes dispatch; real TPU keeps full
+    async pipelining.
+    """
+    return all(d.platform == "cpu" for d in mesh.devices.flat)
+
+
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
