@@ -1,0 +1,128 @@
+"""Checkpoint/resume tests (SURVEY.md §5.4 — a gap the reference leaves open).
+
+Load-bearing properties: round-trip bitwise fidelity (incl. bfloat16
+leaves), resume-equivalence (train k then save/restore/train k == train 2k
+straight through), retention pruning, and structure-mismatch detection.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpudml.checkpoint import (
+    CheckpointManager,
+    checkpoint_hook,
+    latest_checkpoint,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from tpudml.core.prng import seed_key
+from tpudml.data.datasets import synthetic_classification
+from tpudml.models import LeNet
+from tpudml.optim import make_optimizer
+from tpudml.train import TrainState, make_train_step
+
+
+@pytest.fixture()
+def state():
+    model = LeNet()
+    opt = make_optimizer("adam", 1e-3)
+    return model, opt, TrainState.create(model, opt, seed_key(0))
+
+
+def test_roundtrip_bitwise(tmp_path, state):
+    _, _, ts = state
+    path = save_checkpoint(tmp_path, ts, step=7, metadata={"note": "x"})
+    assert latest_checkpoint(tmp_path) == str(path)
+    model = LeNet()
+    opt = make_optimizer("adam", 1e-3)
+    fresh = TrainState.create(model, opt, seed_key(1))
+    restored = restore_checkpoint(path, fresh)
+    for a, b in zip(jax.tree.leaves(ts), jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_roundtrip_bfloat16(tmp_path):
+    tree = {"w": jnp.arange(12, dtype=jnp.bfloat16).reshape(3, 4), "n": jnp.int32(3)}
+    path = save_checkpoint(tmp_path, tree, step=0)
+    out = restore_checkpoint(path, jax.tree.map(jnp.zeros_like, tree))
+    assert np.asarray(out["w"]).dtype == jnp.bfloat16
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.asarray(tree["w"]))
+
+
+def test_resume_equivalence(tmp_path, state):
+    model, opt, ts = state
+    images, labels = synthetic_classification(16, (28, 28, 1), 10, seed=3)
+    step = make_train_step(model, opt)
+
+    for _ in range(2):
+        ts, _ = step(ts, images, labels)
+    save_checkpoint(tmp_path, ts, step=2)
+
+    resumed = restore_checkpoint(
+        latest_checkpoint(tmp_path), TrainState.create(model, opt, seed_key(9))
+    )
+    for _ in range(2):
+        ts, _ = step(ts, images, labels)
+        resumed, _ = step(resumed, images, labels)
+    for a, b in zip(jax.tree.leaves(ts.params), jax.tree.leaves(resumed.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_manager_retention_and_latest(tmp_path, state):
+    _, _, ts = state
+    mgr = CheckpointManager(tmp_path, keep=2)
+    for s in (1, 2, 3, 4):
+        mgr.save(ts, s)
+    assert mgr.latest_step() == 4
+    kept = sorted(p.name for p in tmp_path.iterdir())
+    assert kept == ["step_3", "step_4"]
+
+
+def test_structure_mismatch_raises(tmp_path):
+    path = save_checkpoint(tmp_path, {"a": jnp.ones(3)}, step=0)
+    with pytest.raises(ValueError, match="structure mismatch"):
+        restore_checkpoint(path, {"a": jnp.ones(3), "b": jnp.ones(2)})
+    with pytest.raises(ValueError, match="shape"):
+        restore_checkpoint(path, {"a": jnp.ones(4)})
+
+
+def _at_step(ts, s):
+    return TrainState(
+        params=ts.params,
+        model_state=ts.model_state,
+        opt_state=ts.opt_state,
+        step=jnp.int32(s),
+    )
+
+
+def test_train_loop_hook(tmp_path, state):
+    model, opt, ts = state
+    mgr = CheckpointManager(tmp_path, keep=3)
+    hook = checkpoint_hook(mgr, every=2)
+    for s in range(1, 5):
+        hook(epoch=0, step=s, train_state=_at_step(ts, s), metrics={})
+    assert mgr.latest_step() == 4
+    assert sorted(p.name for p in tmp_path.iterdir()) == ["step_2", "step_4"]
+
+
+def test_hook_keys_by_global_step_across_resume(tmp_path, state):
+    """After a resume, the loop counter restarts at 1 but the TrainState
+    step is monotonic — retention must keep the post-resume checkpoints,
+    not resurrect the pre-crash one."""
+    model, opt, ts = state
+    mgr = CheckpointManager(tmp_path, keep=2)
+    hook = checkpoint_hook(mgr, every=2)
+    hook(epoch=0, step=100, train_state=_at_step(ts, 100), metrics={})
+    # "Restart": loop counter back to 1..4, global step continues 101..104.
+    for counter, global_step in enumerate(range(101, 105), start=1):
+        hook(epoch=0, step=counter, train_state=_at_step(ts, global_step), metrics={})
+    assert mgr.latest_step() == 104
+    assert sorted(p.name for p in tmp_path.iterdir()) == ["step_102", "step_104"]
+
+
+def test_restore_latest_passthrough_when_empty(tmp_path, state):
+    _, _, ts = state
+    mgr = CheckpointManager(tmp_path / "none")
+    assert mgr.restore_latest(ts) is ts

@@ -1,0 +1,36 @@
+"""Checkpoint / resume subsystem.
+
+The reference has no persistence at all: model state exists only for the
+process lifetime and is re-synchronised by a rank-0 broadcast at train
+start (``init_parameters``, codes/task2/dist_utils.py:33-37;
+SURVEY.md §5.4 flags this as a gap to fill, not copy). This module adds
+the TPU-pod-grade story: atomic pytree checkpoints written by process 0,
+restored identically on every host — the persistent generalisation of the
+reference's broadcast-from-rank-0 contract.
+
+Design notes (TPU-first):
+- A checkpoint is one ``.npz`` of pytree leaves + a JSON manifest. Leaves
+  are fetched with ``jax.device_get`` (one host sync, not per-leaf).
+- Extended dtypes (bfloat16 &c.) aren't npz-native; they are stored as raw
+  uint16/uint8 views and the true dtype recorded in the manifest.
+- Writes go to a temp dir then ``os.replace`` — a crash mid-write never
+  corrupts the latest checkpoint (required for preemptible TPU pods).
+- Restore takes a *target* pytree (e.g. a freshly built TrainState) and
+  refills its leaves, so the treedef never needs serialising.
+"""
+
+from tpudml.checkpoint.store import (
+    CheckpointManager,
+    checkpoint_hook,
+    latest_checkpoint,
+    restore_checkpoint,
+    save_checkpoint,
+)
+
+__all__ = [
+    "CheckpointManager",
+    "checkpoint_hook",
+    "latest_checkpoint",
+    "restore_checkpoint",
+    "save_checkpoint",
+]
