@@ -1,0 +1,119 @@
+"""Micro-batched pipeline (GPipe) tests on the simulated CPU mesh.
+
+Load-bearing property (SURVEY.md §7: model-parallel parity = loss-curve
+equivalence, not mechanism equivalence): the pipelined forward/backward
+over S stages × M micro-batches is mathematically the plain sequential
+model — so logits, gradients, and whole training trajectories must match a
+single-device reference to float tolerance.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpudml.core.config import MeshConfig
+from tpudml.core.dist import make_mesh
+from tpudml.core.prng import seed_key
+from tpudml.nn import Activation, Dense, Sequential
+from tpudml.nn.losses import softmax_cross_entropy
+from tpudml.optim import make_optimizer
+from tpudml.parallel.pp import GPipe
+
+STAGES = 4
+WIDTH = 32
+BATCH = 16
+
+
+def make_pipe(n_microbatches=8, mesh=None, opt=None):
+    mesh = mesh or make_mesh(MeshConfig({"stage": STAGES}), jax.devices()[:STAGES])
+    block = Sequential((Dense(WIDTH, WIDTH), Activation(jax.nn.relu)))
+    return GPipe(
+        block,
+        n_microbatches=n_microbatches,
+        mesh=mesh,
+        optimizer=opt or make_optimizer("sgd", 0.05, momentum=0.9),
+        prologue=Dense(16, WIDTH),
+        epilogue=Dense(WIDTH, 10),
+    )
+
+
+@pytest.fixture(scope="module")
+def batch():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(BATCH, 16)).astype(np.float32)
+    y = rng.integers(0, 10, size=(BATCH,)).astype(np.int32)
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+@pytest.mark.parametrize("n_mb", [1, 2, 8, 16])
+def test_forward_matches_sequential(batch, n_mb):
+    """n_mb=1 is the reference task4 regime (degenerate pipeline); higher
+    micro-batch counts must not change the math."""
+    x, _ = batch
+    pipe = make_pipe(n_mb)
+    params = pipe.init_params(seed_key(0))
+    got = pipe.make_forward()(params, x)
+    want = pipe.sequential_forward(params, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-6)
+
+
+def test_train_step_matches_single_device_update(batch):
+    x, y = batch
+    opt = make_optimizer("sgd", 0.05, momentum=0.9)
+    pipe = make_pipe(8, opt=opt)
+    ts = pipe.create_state(seed_key(1))
+    params0 = jax.device_get(ts.params)
+
+    new_ts, metrics = pipe.make_train_step()(ts, x, y)
+
+    ref_loss = lambda p: softmax_cross_entropy(pipe.sequential_forward(p, x), y)
+    loss0, ref_grads = jax.value_and_grad(ref_loss)(params0)
+    ref_params, _ = opt.update(ref_grads, opt.init(params0), params0)
+
+    np.testing.assert_allclose(float(metrics["loss"]), float(loss0), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(new_ts.params), jax.tree.leaves(ref_params)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6
+        )
+    assert int(new_ts.step) == 1
+
+
+def test_training_trajectory_parity_and_descent(batch):
+    """Five pipeline steps == five single-device steps (the §7 parity
+    criterion), and the loss goes down."""
+    x, y = batch
+    opt = make_optimizer("sgd", 0.05, momentum=0.9)
+    pipe = make_pipe(4, opt=opt)
+    ts = pipe.create_state(seed_key(2))
+    ref_params = jax.device_get(ts.params)
+    ref_opt = opt.init(ref_params)
+    ref_loss = lambda p: softmax_cross_entropy(pipe.sequential_forward(p, x), y)
+
+    step = pipe.make_train_step()
+    losses = []
+    for _ in range(5):
+        ts, m = step(ts, x, y)
+        losses.append(float(m["loss"]))
+        g = jax.grad(ref_loss)(ref_params)
+        ref_params, ref_opt = opt.update(g, ref_opt, ref_params)
+
+    for a, b in zip(jax.tree.leaves(ts.params), jax.tree.leaves(ref_params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-5)
+    assert losses[-1] < losses[0]
+
+
+def test_batch_not_divisible_raises(batch):
+    x, y = batch
+    pipe = make_pipe(3)  # 16 % 3 != 0
+    with pytest.raises(ValueError, match="not divisible"):
+        pipe.make_forward()(pipe.init_params(seed_key(0)), x)
+
+
+def test_stateful_block_rejected():
+    from tpudml.nn import BatchNorm
+
+    mesh = make_mesh(MeshConfig({"stage": 2}), jax.devices()[:2])
+    pipe = GPipe(BatchNorm(WIDTH), 2, mesh, make_optimizer("sgd", 0.1))
+    with pytest.raises(ValueError, match="stateless"):
+        pipe.init_params(seed_key(0))

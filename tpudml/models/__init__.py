@@ -2,6 +2,7 @@ from tpudml.models.lenet import LeNet
 from tpudml.models.mlp import ForwardMLP
 from tpudml.models.resnet import ResNet, ResNet18, ResNet34
 from tpudml.models.staged import StagedModel, lenet_stages
+from tpudml.models.transformer import TransformerBlock, TransformerLM
 
 __all__ = [
     "LeNet",
@@ -11,4 +12,6 @@ __all__ = [
     "ResNet34",
     "StagedModel",
     "lenet_stages",
+    "TransformerBlock",
+    "TransformerLM",
 ]

@@ -1,0 +1,143 @@
+"""Transformer blocks and a decoder-only LM.
+
+No analogue exists in the reference (its models are a LeNet CNN and an MLP
+— SURVEY.md §5.7 records the absence of any sequence model), but
+long-context capability is first-class here, so the transformer is the
+framework's flagship sequence model:
+
+- ``TransformerBlock`` is stateless and shape-preserving — exactly the
+  homogeneous-stage contract of the GPipe engine (``tpudml.parallel.pp``),
+  so depth scales by pipeline stages;
+- attention ``impl`` ("full" | "ring" | "ulysses") selects single-chip or
+  sequence-sharded execution (``tpudml.parallel.cp``) from one model
+  definition;
+- position embeddings are computed from *global* offsets when the sequence
+  axis is sharded, so the same weights give identical math either way.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpudml.nn.attention import MultiHeadAttention
+from tpudml.nn.layers import Dense, LayerNorm, Module
+
+
+@dataclass(frozen=True)
+class TransformerBlock(Module):
+    """Pre-LN decoder block: x + MHA(LN(x)); x + MLP(LN(x))."""
+
+    embed_dim: int
+    num_heads: int
+    causal: bool = True
+    impl: str = "full"
+    axis_name: str = "seq"
+    mlp_ratio: int = 4
+    dtype: Any = jnp.float32
+
+    def _parts(self):
+        d = self.embed_dim
+        return {
+            "ln1": LayerNorm(d, dtype=self.dtype),
+            "attn": MultiHeadAttention(
+                d,
+                self.num_heads,
+                causal=self.causal,
+                impl=self.impl,
+                axis_name=self.axis_name,
+                dtype=self.dtype,
+            ),
+            "ln2": LayerNorm(d, dtype=self.dtype),
+            "fc1": Dense(d, self.mlp_ratio * d, dtype=self.dtype),
+            "fc2": Dense(self.mlp_ratio * d, d, dtype=self.dtype),
+        }
+
+    def init(self, key):
+        parts = self._parts()
+        keys = jax.random.split(key, len(parts))
+        return {n: m.init(k)[0] for (n, m), k in zip(parts.items(), keys)}, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        parts = self._parts()
+        h = parts["ln1"](params["ln1"], x)
+        h = parts["attn"](params["attn"], h)
+        x = x + h
+        h = parts["ln2"](params["ln2"], x)
+        h = jax.nn.gelu(parts["fc1"](params["fc1"], h))
+        h = parts["fc2"](params["fc2"], h)
+        return x + h, state
+
+
+@dataclass(frozen=True)
+class TransformerLM(Module):
+    """Decoder-only language model: token + learned position embeddings,
+    N pre-LN blocks, final LayerNorm, vocab projection.
+
+    ``seq_sharded=True`` makes position lookup use the device's global
+    offset along ``axis_name`` (the model then must run under shard_map
+    with the time axis sharded — the ContextParallel engine's regime).
+    """
+
+    vocab_size: int
+    embed_dim: int = 128
+    num_heads: int = 4
+    num_layers: int = 2
+    max_len: int = 1024
+    impl: str = "full"
+    axis_name: str = "seq"
+    seq_sharded: bool = False
+    dtype: Any = jnp.float32
+
+    def _block(self) -> TransformerBlock:
+        return TransformerBlock(
+            self.embed_dim,
+            self.num_heads,
+            causal=True,
+            impl=self.impl,
+            axis_name=self.axis_name,
+            dtype=self.dtype,
+        )
+
+    def init(self, key):
+        ke, kp, kb, kl, kh = jax.random.split(key, 5)
+        d = self.embed_dim
+        params = {
+            "tok_embed": 0.02
+            * jax.random.normal(ke, (self.vocab_size, d), self.dtype),
+            "pos_embed": 0.02 * jax.random.normal(kp, (self.max_len, d), self.dtype),
+            "ln_f": LayerNorm(d, dtype=self.dtype).init(kl)[0],
+            "head": Dense(d, self.vocab_size, dtype=self.dtype).init(kh)[0],
+        }
+        block = self._block()
+        for i, k in enumerate(jax.random.split(kb, self.num_layers)):
+            params[f"block{i}"] = block.init(k)[0]
+        return params, {}
+
+    def apply(self, params, state, tokens, *, train=False, rng=None):
+        t_local = tokens.shape[1]
+        t_global = (
+            lax.axis_size(self.axis_name) * t_local if self.seq_sharded else t_local
+        )
+        if t_global > self.max_len:
+            # Trace-time guard: out-of-range gathers clamp silently under
+            # jit, which would reuse pos_embed[max_len-1] for the overflow
+            # and corrupt position information without any signal.
+            raise ValueError(
+                f"sequence length {t_global} exceeds max_len {self.max_len}"
+            )
+        offset = (
+            lax.axis_index(self.axis_name) * t_local if self.seq_sharded else 0
+        )
+        pos = offset + jnp.arange(t_local)
+        h = params["tok_embed"][tokens] + params["pos_embed"][pos]
+        block = self._block()
+        for i in range(self.num_layers):
+            h, _ = block.apply(params[f"block{i}"], {}, h, train=train, rng=rng)
+        h = LayerNorm(self.embed_dim, dtype=self.dtype)(params["ln_f"], h)
+        head = Dense(self.embed_dim, self.vocab_size, dtype=self.dtype)
+        return head(params["head"], h), state

@@ -6,10 +6,12 @@ from tpudml.nn.layers import (
     Dense,
     Dropout,
     Flatten,
+    LayerNorm,
     MaxPool,
     Module,
     Sequential,
 )
+from tpudml.nn.attention import MultiHeadAttention, dot_product_attention
 
 __all__ = [
     "Module",
@@ -21,5 +23,8 @@ __all__ = [
     "Activation",
     "BatchNorm",
     "Dropout",
+    "LayerNorm",
     "Sequential",
+    "MultiHeadAttention",
+    "dot_product_attention",
 ]

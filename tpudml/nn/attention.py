@@ -1,0 +1,105 @@
+"""Attention ops and the multi-head attention module.
+
+The reference has no attention anywhere (models are a 28×28 CNN and an
+MLP; SURVEY.md §5.7) — but long-context support is first-class in this
+framework, so attention is built TPU-first from the start:
+
+- layout [B, T, H, D] with the contraction kept as two einsums that XLA
+  maps straight onto the MXU;
+- optional causal masking by *global* position offsets, so the same code
+  is correct when the sequence axis is sharded across devices (ring /
+  Ulysses context parallelism in ``tpudml.parallel.cp``);
+- the module's ``impl`` field selects full, ring, or Ulysses attention,
+  letting one model definition run single-chip or sequence-sharded.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from tpudml.nn.layers import Dense, Module
+
+NEG_INF = -1e30  # large-finite mask value: avoids inf-inf → NaN in softmax
+
+
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    q_offset: jax.Array | int = 0,
+    k_offset: jax.Array | int = 0,
+) -> jax.Array:
+    """Scaled dot-product attention over [B, T, H, D] tensors.
+
+    ``q_offset``/``k_offset`` are the global positions of q[:,0] and
+    k[:,0]: with a sharded sequence axis each device passes its shard's
+    offset and the causal mask stays globally correct.
+    """
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(jnp.asarray(d, q.dtype))
+    if causal:
+        q_pos = q_offset + jnp.arange(q.shape[1])
+        k_pos = k_offset + jnp.arange(k.shape[1])
+        mask = q_pos[:, None] >= k_pos[None, :]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+@dataclass(frozen=True)
+class MultiHeadAttention(Module):
+    """Self-attention with fused QKV projection.
+
+    ``impl``: "full" (one-device softmax(QKᵀ)V), "ring" (sequence sharded
+    over ``axis_name``, K/V blocks rotated over the ring — must run under
+    shard_map), or "ulysses" (all-to-all head↔sequence transpose — heads
+    must divide the axis size).
+    """
+
+    embed_dim: int
+    num_heads: int
+    causal: bool = False
+    impl: str = "full"
+    axis_name: str = "seq"
+    dtype: Any = jnp.float32
+
+    def __post_init__(self):
+        if self.embed_dim % self.num_heads:
+            raise ValueError(
+                f"embed_dim {self.embed_dim} % num_heads {self.num_heads} != 0"
+            )
+
+    def init(self, key):
+        kq, ko = jax.random.split(key)
+        qkv = Dense(self.embed_dim, 3 * self.embed_dim, dtype=self.dtype)
+        out = Dense(self.embed_dim, self.embed_dim, dtype=self.dtype)
+        return {"qkv": qkv.init(kq)[0], "out": out.init(ko)[0]}, {}
+
+    def _heads(self, x):
+        b, t, _ = x.shape
+        return x.reshape(b, t, self.num_heads, self.embed_dim // self.num_heads)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        b, t, _ = x.shape
+        qkv = x @ params["qkv"]["kernel"] + params["qkv"]["bias"]
+        q, k, v = (self._heads(a) for a in jnp.split(qkv, 3, axis=-1))
+        if self.impl == "full":
+            o = dot_product_attention(q, k, v, causal=self.causal)
+        elif self.impl == "ring":
+            from tpudml.parallel.cp import ring_attention
+
+            o = ring_attention(q, k, v, self.axis_name, causal=self.causal)
+        elif self.impl == "ulysses":
+            from tpudml.parallel.cp import ulysses_attention
+
+            o = ulysses_attention(q, k, v, self.axis_name, causal=self.causal)
+        else:
+            raise ValueError(f"unknown attention impl {self.impl!r}")
+        o = o.reshape(b, t, self.embed_dim)
+        return o @ params["out"]["kernel"] + params["out"]["bias"], state

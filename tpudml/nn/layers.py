@@ -224,6 +224,29 @@ class BatchNorm(Module):
 
 
 @dataclass(frozen=True)
+class LayerNorm(Module):
+    """Layer normalization over the trailing feature axis (the transformer
+    norm; batch-size independent, so it needs no cross-replica state sync
+    under data or sequence sharding)."""
+
+    num_features: int
+    eps: float = 1e-5
+    dtype: Any = jnp.float32
+
+    def init(self, key):
+        return {
+            "scale": jnp.ones((self.num_features,), self.dtype),
+            "bias": jnp.zeros((self.num_features,), self.dtype),
+        }, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        y = (x - mean) * lax.rsqrt(var + self.eps)
+        return y * params["scale"] + params["bias"], state
+
+
+@dataclass(frozen=True)
 class Sequential(Module):
     """Chain of modules; params/state are dicts keyed ``layer{i}``."""
 

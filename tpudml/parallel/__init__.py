@@ -15,12 +15,18 @@ from tpudml.parallel.sharding import (
     shard_batch,
     shard_map_fn,
 )
+from tpudml.parallel.cp import ContextParallel, ring_attention, ulysses_attention
 from tpudml.parallel.dp import DataParallel, make_dp_train_step
 from tpudml.parallel.mp import GSPMDParallel, apply_rules, stage_sharding_rules
+from tpudml.parallel.pp import GPipe
 
 __all__ = [
+    "ContextParallel",
     "DataParallel",
+    "GPipe",
     "GSPMDParallel",
+    "ring_attention",
+    "ulysses_attention",
     "make_dp_train_step",
     "apply_rules",
     "stage_sharding_rules",

@@ -1,0 +1,224 @@
+"""Context (sequence) parallelism: ring attention and Ulysses all-to-all.
+
+Absent from the reference (SURVEY.md §5.7: no attention, no sequence
+dimension), but first-class here: long sequences are sharded over a mesh
+``seq`` axis so activation memory per chip scales 1/W, and only K/V blocks
+(ring) or head-groups (Ulysses) move over ICI.
+
+- **Ring attention**: each device keeps its Q shard resident and rotates
+  K/V shards around the ring with ``lax.ppermute``, folding each arriving
+  block into a numerically-stable online softmax (running max + running
+  normalizer, flash-attention style, accumulated in float32). W steps see
+  every block exactly once; communication overlaps compute tick by tick.
+  Causal masking uses *global* positions derived from the block's origin
+  device, so semantics are identical to full attention.
+- **Ulysses**: ``lax.all_to_all`` transposes the sharding from sequence to
+  heads ([B,T/W,H,D] → [B,T,H/W,D]), runs ordinary full attention on the
+  now-complete sequence for the local head group, and transposes back.
+  Needs num_heads % W == 0; two collectives per attention instead of W
+  ring hops.
+
+Both are pure jittable functions (must run under shard_map with
+``axis_name`` bound) and differentiate exactly — ppermute/all_to_all
+transpose to their inverses, so gradients route back to the owning shard.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpudml.comm.collectives import all_to_all, pmean_tree, ppermute_ring
+from tpudml.nn.attention import NEG_INF
+from tpudml.nn.layers import Module
+from tpudml.nn.losses import accuracy, softmax_cross_entropy
+from tpudml.optim import Optimizer
+from tpudml.parallel.sharding import serialize_dispatch, shard_map_fn
+from tpudml.train import TrainState
+
+PyTree = Any
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    *,
+    causal: bool = False,
+) -> jax.Array:
+    """Ring self-attention over a sharded sequence axis.
+
+    Args are the local shards [B, T/W, H, D]. Returns the local output
+    shard, bitwise-independent of W up to float accumulation order.
+    """
+    world = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    b, t_local, h, d = q.shape
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    q_pos = idx * t_local + jnp.arange(t_local)
+
+    def fold(acc, kb, vb, src):
+        """Merge one K/V block into the online-softmax accumulator
+        (associative, so block arrival order doesn't matter)."""
+        o, m, l = acc
+        k_pos = src * t_local + jnp.arange(t_local)
+        s = (
+            jnp.einsum("bqhd,bkhd->bhqk", q, kb, preferred_element_type=jnp.float32)
+            * scale
+        )
+        if causal:
+            s = jnp.where((q_pos[:, None] >= k_pos[None, :])[None, None], s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p, vb, preferred_element_type=jnp.float32)
+        o_new = o * alpha.transpose(0, 2, 1)[..., None] + pv
+        return o_new, m_new, l_new
+
+    # Step 0: the resident local block — no communication. Steps 1..W-1:
+    # rotate, then fold the block that originated on device (idx - step);
+    # rotating at the top of the body avoids a W-th ppermute whose result
+    # would be discarded.
+    acc0 = fold(
+        (
+            jnp.zeros((b, t_local, h, d), jnp.float32),
+            jnp.full((b, h, t_local), -jnp.inf, jnp.float32),
+            jnp.zeros((b, h, t_local), jnp.float32),
+        ),
+        k,
+        v,
+        idx,
+    )
+
+    def tick(carry, step):
+        acc, kb, vb = carry
+        kb = ppermute_ring(kb, axis_name)
+        vb = ppermute_ring(vb, axis_name)
+        acc = fold(acc, kb, vb, (idx - step) % world)
+        return (acc, kb, vb), None
+
+    ((o, _, l), _, _), _ = lax.scan(tick, (acc0, k, v), jnp.arange(1, world))
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    *,
+    causal: bool = False,
+) -> jax.Array:
+    """All-to-all (DeepSpeed-Ulysses-style) attention: reshard sequence→
+    heads, full attention locally, reshard back."""
+    from tpudml.nn.attention import dot_product_attention
+
+    world = lax.axis_size(axis_name)
+    if q.shape[2] % world:
+        raise ValueError(
+            f"ulysses needs num_heads {q.shape[2]} divisible by axis size {world}"
+        )
+    qg, kg, vg = (
+        all_to_all(a, axis_name, split_axis=2, concat_axis=1) for a in (q, k, v)
+    )
+    o = dot_product_attention(qg, kg, vg, causal=causal)
+    return all_to_all(o, axis_name, split_axis=1, concat_axis=2)
+
+
+class ContextParallel:
+    """Sequence-parallel training engine over a mesh ``seq`` axis.
+
+    The model must be built seq-sharded (e.g. ``TransformerLM(...,
+    impl="ring", seq_sharded=True)``); parameters stay replicated, the
+    time axis of inputs/labels is sharded, and parameter gradients are
+    pmean-ed over the axis (per-shard token-mean losses of equal-size
+    shards average to the global token mean).
+    """
+
+    def __init__(
+        self,
+        model: Module,
+        optimizer: Optimizer,
+        mesh: Mesh,
+        axis_name: str = "seq",
+    ):
+        self.model = model
+        self.optimizer = optimizer
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.world = mesh.shape[axis_name]
+        self._sync_each_step = serialize_dispatch(mesh)
+
+    def create_state(self, key: jax.Array) -> TrainState:
+        from tpudml.parallel.sharding import replicate
+
+        return replicate(
+            TrainState.create(self.model, self.optimizer, key), self.mesh
+        )
+
+    def _batch_spec(self) -> P:
+        return P(None, self.axis_name)  # [B, T, ...] sharded along time
+
+    def make_forward(self) -> Callable:
+        fwd = shard_map_fn(
+            lambda params, x: self.model(params, x),
+            self.mesh,
+            in_specs=(P(), self._batch_spec()),
+            out_specs=self._batch_spec(),
+        )
+        return jax.jit(fwd)
+
+    def make_train_step(self) -> Callable:
+        axis = self.axis_name
+
+        def spmd(ts: TrainState, tokens, labels):
+            def loss_fn(params):
+                logits, new_state = self.model.apply(
+                    params, ts.model_state, tokens, train=True
+                )
+                return softmax_cross_entropy(logits, labels), (new_state, logits)
+
+            (loss, (model_state, logits)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(ts.params)
+            grads = pmean_tree(grads, axis)
+            # Shard-consistent model state (e.g. norm running stats), same
+            # treatment as the DP engine: averaged so replicas stay equal.
+            model_state = pmean_tree(model_state, axis)
+            new_params, new_opt = self.optimizer.update(grads, ts.opt_state, ts.params)
+            metrics = {
+                "loss": lax.pmean(loss, axis),
+                "accuracy": lax.pmean(accuracy(logits, labels), axis),
+            }
+            new_ts = TrainState(
+                params=new_params,
+                model_state=model_state,
+                opt_state=new_opt,
+                step=ts.step + 1,
+            )
+            return new_ts, metrics
+
+        spec = self._batch_spec()
+        jitted = jax.jit(
+            shard_map_fn(
+                spmd,
+                self.mesh,
+                in_specs=(P(), spec, spec),
+                out_specs=(P(), P()),
+            )
+        )
+
+        def step(ts: TrainState, tokens, labels):
+            out = jitted(ts, jnp.asarray(tokens), jnp.asarray(labels))
+            if self._sync_each_step:
+                jax.block_until_ready(out[1]["loss"])
+            return out
+
+        return step
