@@ -1,0 +1,98 @@
+"""Launcher tests: templating, env contract, failure containment, and a
+real 2-process jax.distributed job (the multi-node-without-a-cluster story,
+SURVEY.md §4, as an automated fixture instead of manual terminals)."""
+
+import io
+import sys
+
+import pytest
+
+from tpudml.launch import ClusterSpec, launch
+
+PY = sys.executable
+
+
+def test_spec_json_roundtrip(tmp_path):
+    spec = ClusterSpec(
+        num_processes=3,
+        bottleneck_rank=1,
+        rank_env={0: {"FOO": "bar"}},
+        timeout_s=12.5,
+    )
+    path = tmp_path / "cluster.json"
+    spec.to_json(path)
+    back = ClusterSpec.from_json(path)
+    assert back == spec
+
+
+def test_spec_json_unknown_field_rejected(tmp_path):
+    path = tmp_path / "bad.json"
+    path.write_text('{"num_processes": 2, "imagee": "typo"}')
+    with pytest.raises(ValueError, match="unknown ClusterSpec fields"):
+        ClusterSpec.from_json(path)
+
+
+def test_env_contract_and_templating():
+    """Each rank sees the TPUDML_* rendezvous vars and {rank}/{world}
+    substitution; all ranks agree on the coordinator."""
+    sink = io.StringIO()
+    spec = ClusterSpec(num_processes=2)
+    code = (
+        "import os;"
+        "print(os.environ['TPUDML_PROCESS_ID'], os.environ['TPUDML_NUM_PROCESSES'],"
+        " os.environ['TPUDML_COORDINATOR'], 'arg={rank}/{world}')"
+    )
+    result = launch([PY, "-c", code], spec, sink=sink)
+    assert result.success, sink.getvalue()
+    lines = sorted(sink.getvalue().strip().splitlines())
+    assert len(lines) == 2
+    coord = lines[0].split()[4]
+    assert coord.startswith("127.0.0.1:")
+    assert f"[rank 0] 0 2 {coord} arg=0/2" in lines
+    assert f"[rank 1] 1 2 {coord} arg=1/2" in lines
+
+
+def test_failure_containment():
+    """One rank dying must take the whole job down promptly — the
+    reference's hang-forever gap (SURVEY.md §5.3)."""
+    spec = ClusterSpec(num_processes=2, grace_s=2.0)
+    code = "import sys,time; sys.exit(1) if {rank} == 1 else time.sleep(60)"
+    result = launch([PY, "-c", code], spec, sink=io.StringIO())
+    assert not result.success
+    assert result.failed_rank == 1
+    assert result.returncodes[1] == 1
+    assert result.returncodes[0] != 0  # terminated, not left hanging
+    assert result.elapsed_s < 30
+
+
+def test_timeout():
+    spec = ClusterSpec(num_processes=2, timeout_s=1.0, grace_s=1.0)
+    result = launch([PY, "-c", "import time; time.sleep(60)"], spec, sink=io.StringIO())
+    assert not result.success
+    assert result.timed_out
+    assert result.elapsed_s < 20
+
+
+def test_two_process_collective_job():
+    """End-to-end: 2 ranks initialize jax.distributed via the env contract,
+    form a global 2-device mesh, and psum across process boundaries."""
+    sink = io.StringIO()
+    spec = ClusterSpec(num_processes=2, timeout_s=240.0)
+    code = (
+        "import jax, numpy as np, jax.numpy as jnp;"
+        "from jax.sharding import Mesh, PartitionSpec as P;"
+        "from tpudml.core.config import DistributedConfig;"
+        "from tpudml.core.dist import distributed_init, process_index, process_count;"
+        "distributed_init(DistributedConfig.from_env());"
+        "assert process_count() == 2;"
+        "mesh = Mesh(np.array(jax.devices()), ('data',));"
+        "from tpudml.parallel.sharding import shard_map_fn;"
+        "fn = jax.jit(shard_map_fn(lambda x: jax.lax.psum(x, 'data'), mesh, P('data'), P()));"
+        "out = fn(jnp.arange(2.0));"
+        "print(f'rank {process_index()} psum {float(out[0])}')"
+    )
+    result = launch([PY, "-c", code], spec, sink=sink)
+    out = sink.getvalue()
+    assert result.success, out
+    assert "[rank 0] rank 0 psum 1.0" in out
+    assert "[rank 1] rank 1 psum 1.0" in out

@@ -193,4 +193,13 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         cfg.data.division = {"division": "partition", "sampling": "sampling"}.get(
             args.mode, args.mode
         )
+    # Fault-injection knobs exported by the launcher (tpudml.launch) ride the
+    # environment so the task command line stays rank-agnostic. Precedence is
+    # CLI > env: env fills only fields the user left at their defaults.
+    if cfg.bottleneck_rank is None and os.environ.get("TPUDML_BOTTLENECK_RANK"):
+        cfg.bottleneck_rank = int(os.environ["TPUDML_BOTTLENECK_RANK"])
+        if cfg.bottleneck_delay_s == TrainConfig.bottleneck_delay_s:
+            cfg.bottleneck_delay_s = float(
+                os.environ.get("TPUDML_BOTTLENECK_DELAY_S", cfg.bottleneck_delay_s)
+            )
     return cfg

@@ -1,0 +1,22 @@
+"""Launch / deployment subsystem.
+
+TPU-native replacement for the reference's launch layer (SURVEY.md L1):
+manual per-rank CLIs (sections/task2.tex:86-93), ``mp.spawn``
+(codes/task2/model-mp.py:146-148), and the docker-compose topologies whose
+YAML doubled as cluster config (codes/task2/docker-compose.yml,
+codes/task4/docker-compose.yml). One launcher covers CPU-simulated
+multi-process, single-host multi-chip, and multi-host TPU — the task code
+never changes, only the ClusterSpec.
+
+It also fills the reference's failure-detection gap (SURVEY.md §5.3: if
+one rank dies the others hang forever in the collective): the monitor
+terminates the whole job as soon as any rank fails, and enforces an
+optional wall-clock timeout. Straggler/fault injection (the task2
+bottleneck-node experiment, sections/checking.tex:22) is first-class via
+spec fields exported to the ranks' environment.
+"""
+
+from tpudml.launch.cluster import ClusterSpec
+from tpudml.launch.launcher import LaunchResult, launch
+
+__all__ = ["ClusterSpec", "LaunchResult", "launch"]

@@ -1,0 +1,83 @@
+"""CLI: ``python -m tpudml.launch [options] -- <command ...>``.
+
+The one-line replacement for the reference's three launch mechanisms
+(N manual terminals / mp.spawn / docker compose up — SURVEY.md §4):
+
+    # 2-process simulated cluster, task2, bottleneck on rank 1:
+    python -m tpudml.launch --num_processes 2 --bottleneck_rank 1 -- \
+        python -m tasks.task2 --dataset synthetic --epochs 1
+
+    # reference-style explicit per-rank flags via templating:
+    python -m tpudml.launch -n 2 -- \
+        python -m tasks.task2 --n_devices {world} --rank {rank}
+
+``--config cluster.json`` loads a ClusterSpec (the compose-file analogue);
+CLI flags override it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from tpudml.launch.cluster import ClusterSpec
+from tpudml.launch.launcher import launch
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--" in argv:
+        split = argv.index("--")
+        argv, cmd = argv[:split], argv[split + 1 :]
+    else:
+        argv, cmd = argv, []
+    p = argparse.ArgumentParser(prog="tpudml.launch")
+    p.add_argument("--config", type=str, default=None, help="ClusterSpec JSON")
+    p.add_argument("-n", "--num_processes", type=int, default=None)
+    p.add_argument("--coordinator_host", type=str, default=None)
+    p.add_argument("--coordinator_port", type=int, default=None)
+    p.add_argument(
+        "--platform",
+        type=str,
+        default=None,
+        help='"cpu" = simulated cluster; "none" = inherit (TPU pods)',
+    )
+    p.add_argument("--devices_per_process", type=int, default=None)
+    p.add_argument("--timeout_s", type=float, default=None)
+    p.add_argument("--bottleneck_rank", type=int, default=None)
+    p.add_argument("--bottleneck_delay_s", type=float, default=None)
+    args = p.parse_args(argv)
+    if not cmd:
+        p.error("no command given; usage: python -m tpudml.launch [opts] -- cmd ...")
+
+    spec = ClusterSpec.from_json(args.config) if args.config else ClusterSpec()
+    for name in (
+        "num_processes",
+        "coordinator_host",
+        "coordinator_port",
+        "platform",
+        "devices_per_process",
+        "timeout_s",
+        "bottleneck_rank",
+        "bottleneck_delay_s",
+    ):
+        val = getattr(args, name)
+        if val is not None:
+            setattr(spec, name, val)
+    if spec.platform == "none":
+        spec.platform = None
+
+    result = launch(cmd, spec)
+    if result.timed_out:
+        print(f"launch: TIMEOUT after {result.elapsed_s:.1f}s", file=sys.stderr)
+    elif result.failed_rank is not None:
+        print(
+            f"launch: rank {result.failed_rank} failed "
+            f"(rc={result.returncodes[result.failed_rank]}); job terminated",
+            file=sys.stderr,
+        )
+    return 0 if result.success else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,98 @@
+"""Cluster topology specification (the docker-compose.yml replacement)."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+import socket
+from dataclasses import dataclass, field
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@dataclass
+class ClusterSpec:
+    """Everything the launcher needs to stand up an N-process job.
+
+    The reference encodes this per-node in compose YAML — image, mount,
+    rank flags, rendezvous DNS name (codes/task2/docker-compose.yml:4-45).
+    Here it is one typed, JSON-serializable object; rendezvous is the JAX
+    coordinator (``coordinator_address``) instead of MASTER_ADDR/PORT.
+    """
+
+    num_processes: int = 2
+    coordinator_host: str = "127.0.0.1"
+    coordinator_port: int = 0  # 0 → pick a free port at launch
+    # "cpu" = simulated cluster on the host (the mp.spawn analogue);
+    # None = inherit whatever platform the environment provides (TPU pods).
+    platform: str | None = "cpu"
+    devices_per_process: int = 1  # virtual host devices per rank (cpu sim)
+    timeout_s: float | None = None  # whole-job wall-clock limit
+    grace_s: float = 5.0  # SIGTERM → SIGKILL escalation delay
+    # Straggler/fault injection (task2 bottleneck-node experiment).
+    bottleneck_rank: int | None = None
+    bottleneck_delay_s: float = 0.1
+    env: dict[str, str] = field(default_factory=dict)  # extra env, all ranks
+    rank_env: dict[int, dict[str, str]] = field(default_factory=dict)
+
+    def coordinator_address(self) -> str:
+        if self.coordinator_port == 0:
+            # Resolved once per launch; persisted so every rank agrees.
+            self.coordinator_port = _free_port()
+        return f"{self.coordinator_host}:{self.coordinator_port}"
+
+    def environ_for_rank(self, rank: int) -> dict[str, str]:
+        """Child-process environment for ``rank`` (layered over os.environ):
+        the TPUDML_* rendezvous contract read by DistributedConfig.from_env,
+        platform simulation knobs, and fault-injection exports."""
+        env = dict(os.environ)
+        env.update(self.env)
+        env.update(self.rank_env.get(rank, {}))
+        env.update(
+            TPUDML_COORDINATOR=self.coordinator_address(),
+            TPUDML_NUM_PROCESSES=str(self.num_processes),
+            TPUDML_PROCESS_ID=str(rank),
+        )
+        if self.platform == "cpu":
+            env["JAX_PLATFORMS"] = "cpu"
+            env["PALLAS_AXON_POOL_IPS"] = ""  # don't let a TPU relay latch on
+            # Strip any inherited device-count flag: the spec owns the
+            # simulated topology (devices_per_process × num_processes).
+            flags = re.sub(
+                r"--xla_force_host_platform_device_count=\d+",
+                "",
+                env.get("XLA_FLAGS", ""),
+            )
+            env["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{self.devices_per_process}"
+            ).strip()
+        elif self.platform:
+            env["JAX_PLATFORMS"] = self.platform
+        if self.bottleneck_rank is not None:
+            env["TPUDML_BOTTLENECK_RANK"] = str(self.bottleneck_rank)
+            env["TPUDML_BOTTLENECK_DELAY_S"] = str(self.bottleneck_delay_s)
+        return env
+
+    # ------------------------------------------------------------- serde
+
+    def to_json(self, path: str | os.PathLike) -> None:
+        with open(path, "w") as f:
+            json.dump(dataclasses.asdict(self), f, indent=2)
+
+    @classmethod
+    def from_json(cls, path: str | os.PathLike) -> "ClusterSpec":
+        with open(path) as f:
+            raw = json.load(f)
+        raw["rank_env"] = {int(k): v for k, v in raw.get("rank_env", {}).items()}
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(raw) - known
+        if unknown:
+            raise ValueError(f"unknown ClusterSpec fields: {sorted(unknown)}")
+        return cls(**raw)

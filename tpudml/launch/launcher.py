@@ -1,0 +1,129 @@
+"""Process launcher with rank-tagged output and failure containment."""
+
+from __future__ import annotations
+
+import signal
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+
+from tpudml.launch.cluster import ClusterSpec
+
+POLL_S = 0.2
+
+
+@dataclass
+class LaunchResult:
+    returncodes: list[int]
+    elapsed_s: float
+    timed_out: bool = False
+    failed_rank: int | None = None
+
+    @property
+    def success(self) -> bool:
+        return not self.timed_out and all(rc == 0 for rc in self.returncodes)
+
+
+def _substitute(cmd: list[str], rank: int, world: int) -> list[str]:
+    """Per-rank command templating: ``{rank}``/``{world}`` placeholders —
+    the analogue of compose's per-service ``--rank={0,1}`` lines
+    (codes/task2/docker-compose.yml:9-17,30-38)."""
+    return [a.replace("{rank}", str(rank)).replace("{world}", str(world)) for a in cmd]
+
+
+def _pump(proc: subprocess.Popen, rank: int, sink) -> threading.Thread:
+    """Forward a child's merged output line-by-line with a rank tag (the
+    compose service-name prefix analogue; reference relies on `python -u`
+    prints per rank, sections/task2.tex:157)."""
+
+    def run():
+        for line in proc.stdout:  # type: ignore[union-attr]
+            sink.write(f"[rank {rank}] {line}")
+            sink.flush()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t
+
+
+def launch(
+    cmd: list[str],
+    spec: ClusterSpec | None = None,
+    *,
+    sink=None,
+) -> LaunchResult:
+    """Spawn ``spec.num_processes`` copies of ``cmd`` and supervise them.
+
+    Containment semantics (the reference's gap, SURVEY.md §5.3: with
+    synchronous collectives one dead rank leaves every other rank blocked
+    forever): the first rank to exit non-zero triggers SIGTERM (then
+    SIGKILL after ``grace_s``) of the whole job; ``timeout_s`` bounds total
+    wall clock the same way.
+    """
+    spec = spec or ClusterSpec()
+    sink = sink or sys.stdout
+    world = spec.num_processes
+    spec.coordinator_address()  # resolve the port once, before any spawn
+    procs: list[subprocess.Popen] = []
+    pumps: list[threading.Thread] = []
+    t0 = time.monotonic()
+    timed_out = False
+    failed_rank: int | None = None
+    try:
+        for rank in range(world):
+            p = subprocess.Popen(
+                _substitute(cmd, rank, world),
+                env=spec.environ_for_rank(rank),
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+            procs.append(p)
+            pumps.append(_pump(p, rank, sink))
+
+        while True:
+            codes = [p.poll() for p in procs]
+            for rank, rc in enumerate(codes):
+                if rc is not None and rc != 0 and failed_rank is None:
+                    failed_rank = rank
+            done = all(rc is not None for rc in codes)
+            over_time = (
+                spec.timeout_s is not None
+                and time.monotonic() - t0 > spec.timeout_s
+            )
+            if done:
+                break
+            if failed_rank is not None or over_time:
+                timed_out = over_time and failed_rank is None
+                _terminate_all(procs, spec.grace_s)
+                break
+            time.sleep(POLL_S)
+    except BaseException:
+        # A mid-spawn failure (fork error, Ctrl-C) must not leak earlier
+        # ranks as live orphans blocked in the rendezvous.
+        _terminate_all(procs, spec.grace_s)
+        raise
+    for p in procs:
+        p.wait()
+    for t in pumps:
+        t.join(timeout=2)
+    return LaunchResult(
+        returncodes=[p.returncode for p in procs],
+        elapsed_s=time.monotonic() - t0,
+        timed_out=timed_out,
+        failed_rank=failed_rank,
+    )
+
+
+def _terminate_all(procs: list[subprocess.Popen], grace_s: float) -> None:
+    for p in procs:
+        if p.poll() is None:
+            p.send_signal(signal.SIGTERM)
+    deadline = time.monotonic() + grace_s
+    while time.monotonic() < deadline and any(p.poll() is None for p in procs):
+        time.sleep(POLL_S)
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
