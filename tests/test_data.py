@@ -46,9 +46,19 @@ def test_mnist_idx_loading(tmp_path):
     write_idx(raw / "train-labels-idx1-ubyte", labels)
     ds = load_dataset("mnist", str(tmp_path), "train", synthetic_fallback=False)
     assert ds.images.shape == (50, 28, 28, 1)
-    assert ds.images.dtype == np.float32
-    assert ds.images.max() <= 1.0
+    # Default storage keeps raw bytes; normalization is fused into gather.
+    assert ds.images.dtype == np.uint8
+    batch, lbls = ds.gather(np.arange(50))
+    assert batch.dtype == np.float32
+    assert batch.max() <= 1.0
+    np.testing.assert_array_equal(lbls, labels)
     np.testing.assert_array_equal(ds.labels, labels)
+
+    f32 = load_dataset(
+        "mnist", str(tmp_path), "train", synthetic_fallback=False, storage="f32"
+    )
+    assert f32.images.dtype == np.float32
+    np.testing.assert_allclose(batch, f32.images, rtol=1e-6)
 
 
 def test_synthetic_fallback_deterministic():

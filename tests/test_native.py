@@ -1,0 +1,121 @@
+"""C++ data-plane tests: native/fallback parity on every entry point.
+
+The native library is the framework's answer to the reference's vendored
+DataLoader internals (SURVEY.md §2.4 — C++ is the designated language for
+host-side data speed). Every wrapper must be bit-identical to its numpy
+fallback, and the u8-storage pipeline must produce the same batches as
+float32 storage.
+"""
+
+import numpy as np
+import pytest
+
+from tpudml import native
+from tpudml.data import DataLoader
+from tpudml.data.datasets import ArrayDataset
+from tpudml.data.idx import read_idx, write_idx
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.default_rng(7)
+
+
+def test_native_library_builds():
+    """g++ is in the image; the fast path must actually be active here."""
+    assert native.available()
+
+
+def _no_native(monkeypatch):
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_tried", True)
+
+
+def test_gather_rows_f32_matches_numpy(rng, monkeypatch):
+    src = rng.normal(size=(100, 7, 3)).astype(np.float32)
+    idx = rng.integers(0, 100, size=33)
+    fast = native.gather_rows(src, idx)
+    _no_native(monkeypatch)
+    slow = native.gather_rows(src, idx)
+    np.testing.assert_array_equal(fast, slow)
+    np.testing.assert_array_equal(fast, src[idx])
+
+
+def test_gather_rows_u8_matches_numpy(rng):
+    src = rng.integers(0, 255, size=(50, 4, 4, 1)).astype(np.uint8)
+    idx = rng.integers(0, 50, size=16)
+    np.testing.assert_array_equal(native.gather_rows(src, idx), src[idx])
+
+
+def test_gather_normalize_matches_numpy(rng, monkeypatch):
+    src = rng.integers(0, 255, size=(64, 28, 28, 1)).astype(np.uint8)
+    idx = rng.integers(0, 64, size=20)
+    fast = native.gather_normalize(src, idx, scale=1 / 255.0, bias=-0.5)
+    assert fast.dtype == np.float32
+    _no_native(monkeypatch)
+    slow = native.gather_normalize(src, idx, scale=1 / 255.0, bias=-0.5)
+    np.testing.assert_allclose(fast, slow, rtol=1e-6)
+
+
+def test_gather_labels(rng):
+    src = rng.integers(0, 10, size=500).astype(np.int32)
+    idx = rng.integers(0, 500, size=77)
+    np.testing.assert_array_equal(native.gather_labels(src, idx), src[idx])
+
+
+@pytest.mark.parametrize("dtype", [np.int16, np.int32, np.float32, np.float64])
+def test_byteswap_matches_numpy(rng, dtype):
+    arr = (rng.normal(size=97) * 100).astype(dtype)
+    want = arr.byteswap()
+    got = native.byteswap_inplace(arr.copy())
+    np.testing.assert_array_equal(got, want)
+
+
+def test_idx_multibyte_roundtrip(tmp_path):
+    """int32/float IDX payloads exercise the native byteswap on read."""
+    for arr in (
+        np.arange(-50, 50, dtype=np.int32).reshape(10, 10),
+        np.linspace(-1, 1, 24, dtype=np.float32).reshape(2, 3, 4),
+    ):
+        p = tmp_path / f"t-{arr.dtype}.idx"
+        write_idx(p, arr)
+        np.testing.assert_array_equal(read_idx(p), arr)
+
+
+def test_out_of_range_index_raises(rng):
+    """The C++ kernels do raw pointer math — bad indices must be rejected
+    identically on both paths, never read out of bounds."""
+    src = rng.normal(size=(10, 3)).astype(np.float32)
+    with pytest.raises(IndexError):
+        native.gather_rows(src, np.array([0, 10]))
+    with pytest.raises(IndexError):
+        native.gather_labels(np.zeros(10, np.int32), np.array([-11]))
+    # Negative indices follow numpy semantics on both paths.
+    np.testing.assert_array_equal(native.gather_rows(src, np.array([-1])), src[[-1]])
+
+
+def test_getitem_matches_gather(rng):
+    raw = rng.integers(0, 255, size=(10, 4, 4, 1)).astype(np.uint8)
+    ds = ArrayDataset(raw, np.arange(10, dtype=np.int32), scale=1 / 255.0)
+    img, lbl = ds[3]
+    assert img.dtype == np.float32
+    np.testing.assert_allclose(img, raw[3].astype(np.float32) / 255.0)
+    assert lbl == 3
+    imgs, lbls = ds[[1, 2]]
+    assert imgs.shape == (2, 4, 4, 1) and imgs.dtype == np.float32
+
+
+def test_u8_dataset_pipeline_matches_f32(rng):
+    """End-to-end: a u8-storage dataset yields the same batches through the
+    DataLoader as its float32-converted twin."""
+    raw = rng.integers(0, 255, size=(40, 8, 8, 1)).astype(np.uint8)
+    labels = rng.integers(0, 10, size=40).astype(np.int32)
+    ds_u8 = ArrayDataset(raw, labels, scale=1 / 255.0)
+    ds_f32 = ArrayDataset(raw.astype(np.float32) / 255.0, labels)
+    batches_u8 = list(DataLoader(ds_u8, 8))
+    batches_f32 = list(DataLoader(ds_f32, 8))
+    assert len(batches_u8) == len(batches_f32) == 5
+    for (xu, yu), (xf, yf) in zip(batches_u8, batches_f32):
+        assert xu.dtype == np.float32
+        np.testing.assert_allclose(xu, xf, rtol=1e-6)
+        np.testing.assert_array_equal(yu, yf)

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import gzip
 import struct
+import sys
 from pathlib import Path
 
 import numpy as np
@@ -41,9 +42,22 @@ def read_idx(path: str | Path) -> np.ndarray:
     if dtype_code not in _IDX_DTYPES:
         raise ValueError(f"{path}: unknown IDX dtype 0x{dtype_code:02x}")
     dims = struct.unpack(f">{ndim}I", data[4 : 4 + 4 * ndim])
-    dtype = np.dtype(_IDX_DTYPES[dtype_code]).newbyteorder(">")
-    arr = np.frombuffer(data, dtype=dtype, count=int(np.prod(dims)), offset=4 + 4 * ndim)
-    return arr.reshape(dims).astype(_IDX_DTYPES[dtype_code])
+    arr = (
+        np.frombuffer(
+            data,
+            dtype=_IDX_DTYPES[dtype_code],
+            count=int(np.prod(dims)),
+            offset=4 + 4 * ndim,
+        )
+        .reshape(dims)
+        .copy()
+    )
+    if arr.dtype.itemsize > 1 and sys.byteorder == "little":
+        # IDX payloads are big-endian; swap in place (C++ fast path).
+        from tpudml import native
+
+        native.byteswap_inplace(arr)
+    return arr
 
 
 def write_idx(path: str | Path, arr: np.ndarray) -> None:

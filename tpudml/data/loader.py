@@ -49,9 +49,15 @@ class DataLoader:
         end = (
             len(idx) - len(idx) % self.batch_size if self.drop_remainder else len(idx)
         )
+        # Fused native gather (+ normalize for u8 storage) when the dataset
+        # provides it; plain fancy indexing otherwise.
+        gather = getattr(self.dataset, "gather", None)
         for start in range(0, end, self.batch_size):
             batch = idx[start : start + self.batch_size]
-            yield self.dataset.images[batch], self.dataset.labels[batch]
+            if gather is not None:
+                yield gather(batch)
+            else:
+                yield self.dataset.images[batch], self.dataset.labels[batch]
 
 
 class ShardedDataLoader:
