@@ -71,6 +71,34 @@ def test_byteswap_matches_numpy(rng, dtype):
     np.testing.assert_array_equal(got, want)
 
 
+def test_byteswap_rejects_readonly(rng):
+    arr = (rng.normal(size=8) * 10).astype(np.int32)
+    arr.flags.writeable = False
+    with pytest.raises(ValueError, match="writeable"):
+        native.byteswap_inplace(arr)
+
+
+def test_dataset_getitem_bool_and_slice(rng):
+    raw = rng.integers(0, 255, size=(6, 2, 2, 1)).astype(np.uint8)
+    ds = ArrayDataset(raw, np.arange(6, dtype=np.int32), scale=1 / 255.0)
+    mask = np.array([True, False, False, False, True, False])
+    imgs, lbls = ds[mask]
+    np.testing.assert_array_equal(lbls, [0, 4])
+    np.testing.assert_allclose(imgs, raw[[0, 4]].astype(np.float32) / 255.0)
+    imgs, lbls = ds[1:3]
+    np.testing.assert_array_equal(lbls, [1, 2])
+
+
+def test_storage_validation(tmp_path):
+    from tpudml.data.datasets import load_cifar10, load_dataset, load_mnist
+
+    for fn in (load_mnist, load_cifar10):
+        with pytest.raises(ValueError, match="storage"):
+            fn(str(tmp_path), storage="uint8")
+    with pytest.raises(ValueError, match="storage"):
+        load_dataset("synthetic", str(tmp_path), "train", storage="U8")
+
+
 def test_idx_multibyte_roundtrip(tmp_path):
     """int32/float IDX payloads exercise the native byteswap on read."""
     for arr in (

@@ -54,10 +54,16 @@ class ArrayDataset:
     def __getitem__(self, idx):
         # Same semantics as gather (normalized float32 for u8 storage) so
         # the two access paths of the Dataset protocol never disagree.
-        if np.ndim(idx) == 0:
-            imgs, lbls = self.gather(np.asarray([idx], dtype=np.int64))
+        # Supports scalars, index arrays, boolean masks, and slices.
+        if isinstance(idx, slice):
+            idx = np.arange(len(self))[idx]
+        idx = np.asarray(idx)
+        if idx.dtype == np.bool_:
+            idx = np.nonzero(idx)[0]
+        if idx.ndim == 0:
+            imgs, lbls = self.gather(idx[None].astype(np.int64))
             return imgs[0], lbls[0]
-        return self.gather(np.asarray(idx, dtype=np.int64))
+        return self.gather(idx.astype(np.int64))
 
     def gather(self, idx: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
         """Materialize a batch: fused row-gather (+ dequantize-normalize for
@@ -117,6 +123,8 @@ def load_mnist(
     ``storage="u8"`` (default) keeps the raw bytes resident and fuses the
     /255 into batch gathering; ``"f32"`` converts at load time.
     """
+    if storage not in ("u8", "f32"):
+        raise ValueError(f"storage must be 'u8' or 'f32', got {storage!r}")
     data_dir = Path(data_dir)
     img_key = f"{split if split == 'train' else 'test'}_images"
     lbl_key = f"{split if split == 'train' else 'test'}_labels"
@@ -153,6 +161,8 @@ def load_cifar10(
 ) -> ArrayDataset:
     """CIFAR-10 python-pickle batches, NHWC in [0,1] (u8 storage defers the
     /255 to batch time, as in load_mnist)."""
+    if storage not in ("u8", "f32"):
+        raise ValueError(f"storage must be 'u8' or 'f32', got {storage!r}")
     data_dir = Path(data_dir)
     base = None
     for cand in (data_dir / "cifar-10-batches-py", data_dir):
@@ -209,6 +219,9 @@ def load_dataset(name: str, data_dir: str, split: str, **kw) -> ArrayDataset:
     if name == "cifar10":
         return load_cifar10(data_dir, split, **kw)
     if name == "synthetic":
+        storage = kw.pop("storage", "f32")  # synthetic data is generated f32
+        if storage not in ("u8", "f32"):
+            raise ValueError(f"storage must be 'u8' or 'f32', got {storage!r}")
         n = kw.get("synthetic_size") or (4096 if split == "train" else 1024)
         imgs, labels = synthetic_classification(
             n, (28, 28, 1), 10, seed=0 if split == "train" else 1, proto_seed=100

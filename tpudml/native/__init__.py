@@ -151,6 +151,10 @@ def byteswap_inplace(arr: np.ndarray) -> np.ndarray:
     lib = _get()
     if width == 1:
         return arr
+    if not arr.flags.writeable:
+        # The C++ path writes through the raw pointer; mirror numpy's
+        # in-place semantics instead of corrupting a read-only buffer.
+        raise ValueError("byteswap_inplace requires a writeable array")
     if lib is None or not arr.flags.c_contiguous:
         arr[...] = arr.byteswap()
         return arr

@@ -9,8 +9,9 @@ framework, so attention is built TPU-first from the start:
 - optional causal masking by *global* position offsets, so the same code
   is correct when the sequence axis is sharded across devices (ring /
   Ulysses context parallelism in ``tpudml.parallel.cp``);
-- the module's ``impl`` field selects full, ring, or Ulysses attention,
-  letting one model definition run single-chip or sequence-sharded.
+- the module's ``impl`` field selects full, flash (Pallas kernel), ring,
+  or Ulysses attention, letting one model definition run single-chip or
+  sequence-sharded.
 """
 
 from __future__ import annotations
@@ -56,10 +57,11 @@ def dot_product_attention(
 class MultiHeadAttention(Module):
     """Self-attention with fused QKV projection.
 
-    ``impl``: "full" (one-device softmax(QKᵀ)V), "ring" (sequence sharded
-    over ``axis_name``, K/V blocks rotated over the ring — must run under
-    shard_map), or "ulysses" (all-to-all head↔sequence transpose — heads
-    must divide the axis size).
+    ``impl``: "full" (one-device softmax(QKᵀ)V), "flash" (Pallas fused
+    kernel on TPU, reference math elsewhere — tpudml.ops), "ring"
+    (sequence sharded over ``axis_name``, K/V blocks rotated over the ring
+    — must run under shard_map), or "ulysses" (all-to-all head↔sequence
+    transpose — heads must divide the axis size).
     """
 
     embed_dim: int
@@ -91,6 +93,10 @@ class MultiHeadAttention(Module):
         q, k, v = (self._heads(a) for a in jnp.split(qkv, 3, axis=-1))
         if self.impl == "full":
             o = dot_product_attention(q, k, v, causal=self.causal)
+        elif self.impl == "flash":
+            from tpudml.ops import flash_attention
+
+            o = flash_attention(q, k, v, causal=self.causal)
         elif self.impl == "ring":
             from tpudml.parallel.cp import ring_attention
 

@@ -1,0 +1,11 @@
+"""Custom TPU kernels (Pallas).
+
+The reference has no custom kernels (its native compute is vendored cuDNN,
+SURVEY.md §2.4); here the hot ops the XLA fusion engine can't already
+produce optimally are written in Pallas against the TPU memory hierarchy
+(HBM→VMEM→MXU; /opt/skills/guides/pallas_guide.md is the playbook).
+"""
+
+from tpudml.ops.attention_kernel import flash_attention
+
+__all__ = ["flash_attention"]
