@@ -1,0 +1,80 @@
+"""High-level Model API tests (MindSpore-track parity, SURVEY.md §3.5)."""
+
+import io
+from contextlib import redirect_stdout
+
+import jax
+import numpy as np
+import pytest
+
+from tpudml.api import LossMonitor, Model
+from tpudml.data import DataLoader
+from tpudml.data.datasets import ArrayDataset, synthetic_classification
+from tpudml.models import ForwardMLP
+from tpudml.optim import make_optimizer
+
+
+def _dataset(n=128, seed=0):
+    imgs, labels = synthetic_classification(n, (28, 28, 1), 10, seed=seed, proto_seed=9)
+    return ArrayDataset(imgs, labels)
+
+
+def test_train_learns_and_eval_reports():
+    model = Model(
+        ForwardMLP(), optimizer=make_optimizer("adam", 1e-3), metrics={"Accuracy"}
+    )
+    loader = DataLoader(_dataset(256), 32)
+    model.train(10, loader)
+    train_results = model.eval(loader)
+    held_out = model.eval(DataLoader(_dataset(seed=1), 32, drop_remainder=False))
+    assert set(held_out) == {"Accuracy"}
+    assert train_results["Accuracy"] > 0.95
+    assert held_out["Accuracy"] > 0.75
+    assert int(model.state.step) == 10 * 8
+
+
+def test_loss_monitor_prints():
+    model = Model(ForwardMLP(), optimizer=make_optimizer("sgd", 0.01))
+    loader = DataLoader(_dataset(64), 32)
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        model.train(1, loader, callbacks=[LossMonitor(1)])
+    lines = buf.getvalue().strip().splitlines()
+    assert len(lines) == 2  # one per step
+    assert lines[0].startswith("step: 1, loss is ")
+
+
+def test_sink_and_eager_modes_match():
+    """dataset_sink_mode=False is the same math without jit."""
+    loader = DataLoader(_dataset(64), 32)
+    sink = Model(ForwardMLP(), optimizer=make_optimizer("sgd", 0.05), seed=3)
+    eager = Model(ForwardMLP(), optimizer=make_optimizer("sgd", 0.05), seed=3)
+    sink.train(2, loader, dataset_sink_mode=True)
+    eager.train(2, loader, dataset_sink_mode=False)
+    for a, b in zip(
+        jax.tree.leaves(sink.state.params), jax.tree.leaves(eager.state.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7)
+
+
+def test_predict_shape():
+    model = Model(ForwardMLP(), optimizer=make_optimizer("sgd", 0.01))
+    x = np.zeros((5, 28, 28, 1), np.float32)
+    assert model.predict(x).shape == (5, 10)
+
+
+def test_validation():
+    with pytest.raises(ValueError, match="optimizer"):
+        Model(ForwardMLP())
+    with pytest.raises(ValueError, match="unknown metrics"):
+        Model(ForwardMLP(), optimizer=make_optimizer("sgd", 0.01), metrics={"f1"})
+
+
+def test_task1_mlp_entrypoint():
+    from tasks.task1_mlp import main
+
+    metrics = main(
+        ["--dataset", "synthetic", "--epochs", "2", "--optimizer", "adam",
+         "--lr", "0.002", "--log_every", "0", "--batch_size", "64"]
+    )
+    assert metrics["test_accuracy"] > 0.8

@@ -63,14 +63,17 @@ def test_gradients_match_reference(qkv):
         np.testing.assert_allclose(np.asarray(g), np.asarray(r), rtol=1e-4, atol=1e-6)
 
 
-def test_indivisible_block_autofits(qkv):
-    """block_q auto-fits to a divisor of T (gcd), so any T works."""
-    q, k, v = qkv
-    got = flash_attention(q, k, v, block_q=5, interpret=True)  # gcd(32,5)=1
-    want = dot_product_attention(q, k, v)
+@pytest.mark.parametrize("t,block_q,causal", [(30, 16, False), (30, 16, True), (32, 5, True)])
+def test_odd_lengths_pad_and_mask(qkv, t, block_q, causal):
+    """Any T works via pad-and-mask (never by shrinking the MXU block):
+    padded keys get no attention mass, padded queries are sliced off."""
+    q, k, v = (a[:, :t] for a in qkv)
+    got = flash_attention(q, k, v, causal=causal, block_q=block_q, interpret=True)
+    want = dot_product_attention(q, k, v, causal=causal)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-6)
 
 
+@pytest.mark.skipif(jax.default_backend() != "cpu", reason="CPU dispatch path")
 def test_cpu_dispatch_falls_back_to_reference(qkv):
     """interpret=None off-TPU must use the reference math (not the slow
     interpreter): identical values by construction."""

@@ -1,0 +1,170 @@
+"""High-level Model API — the MindSpore-track parity surface.
+
+The reference's second-framework track trains through
+``Model(net, loss, opt, metrics)`` + ``model.train(epochs, ds,
+callbacks=[LossMonitor()], dataset_sink_mode=True)`` + ``model.eval``
+(codes/task1/mindspore/model.ipynb cells 5-7; sections/mindspore.tex).
+SURVEY.md §3.5 notes that sink-mode graph training is the closest thing in
+the reference to the JAX execution model — so here "sink mode" IS the
+native path (one jitted XLA program per step, data fed device-side), and
+``dataset_sink_mode=False`` runs the same math op-by-op un-jitted (the
+eager comparison mode, mainly for debugging).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Iterable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from tpudml.nn.layers import Module
+from tpudml.nn.losses import accuracy, softmax_cross_entropy
+from tpudml.optim import Optimizer
+from tpudml.train import TrainState, make_loss_fn, make_train_step
+
+_METRIC_FNS: dict[str, Callable] = {
+    "accuracy": accuracy,
+    "loss": lambda logits, labels: softmax_cross_entropy(logits, labels),
+}
+
+
+class Callback:
+    """Training callback; MindSpore-Callback-shaped hooks."""
+
+    def on_train_begin(self, model: "Model") -> None: ...
+
+    def on_step_end(self, model: "Model", step: int, loss: float) -> None: ...
+
+    def on_epoch_end(self, model: "Model", epoch: int, loss: float) -> None: ...
+
+    def on_train_end(self, model: "Model") -> None: ...
+
+
+class LossMonitor(Callback):
+    """Parity with mindspore.train.LossMonitor (notebook cell 6): prints
+    the loss every ``per_print_times`` steps."""
+
+    def __init__(self, per_print_times: int = 1):
+        self.per_print_times = per_print_times
+
+    def on_step_end(self, model, step, loss):
+        if self.per_print_times and step % self.per_print_times == 0:
+            print(f"step: {step}, loss is {loss:.6f}")
+
+
+class Model:
+    """``Model(network, loss_fn, optimizer, metrics)`` facade over the
+    functional engine.
+
+    Usage (mirrors the notebook, model.ipynb cells 5-7)::
+
+        model = Model(ForwardMLP(), optimizer=make_optimizer("sgd", 0.01),
+                      metrics={"Accuracy"})
+        model.train(10, train_loader, callbacks=[LossMonitor()])
+        print(model.eval(test_loader))   # {"Accuracy": 0.97}
+    """
+
+    def __init__(
+        self,
+        network: Module,
+        loss_fn: Callable = softmax_cross_entropy,
+        optimizer: Optimizer | None = None,
+        metrics: Sequence[str] | set[str] = ("accuracy",),
+        seed: int = 0,
+    ):
+        if optimizer is None:
+            raise ValueError("Model needs an optimizer")
+        unknown = {m.lower() for m in metrics} - set(_METRIC_FNS)
+        if unknown:
+            raise ValueError(
+                f"unknown metrics {sorted(unknown)}; options: {sorted(_METRIC_FNS)}"
+            )
+        self.network = network
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.metrics = tuple(m.lower() for m in metrics)
+        key = jax.random.key(seed)
+        self.state = TrainState.create(network, optimizer, key)
+        self._rng_root = jax.random.fold_in(key, 0x0D0)
+        self._sink_step = None
+
+    # ------------------------------------------------------------- training
+
+    def _eager_step(self, ts: TrainState, images, labels):
+        """dataset_sink_mode=False: identical math, no jit (debug mode)."""
+        loss_fn = make_loss_fn(self.network, self.loss_fn)
+        rng = jax.random.fold_in(self._rng_root, ts.step)
+        (loss, (model_state, logits)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(ts.params, ts.model_state, images, labels, rng)
+        new_params, new_opt = self.optimizer.update(grads, ts.opt_state, ts.params)
+        ts = TrainState(
+            params=new_params,
+            model_state=model_state,
+            opt_state=new_opt,
+            step=ts.step + 1,
+        )
+        return ts, {"loss": loss, "accuracy": accuracy(logits, labels)}
+
+    def train(
+        self,
+        epochs: int,
+        dataset: Iterable,
+        callbacks: Sequence[Callback] | None = None,
+        dataset_sink_mode: bool = True,
+    ) -> "Model":
+        """Train in place for ``epochs`` passes over ``dataset`` (any
+        iterable of (images, labels); DataLoader supported incl.
+        set_epoch). Returns self for chaining."""
+        callbacks = list(callbacks or [])
+        if dataset_sink_mode and self._sink_step is None:
+            self._sink_step = make_train_step(
+                self.network, self.optimizer, rng_root=self._rng_root
+            )
+        step_fn = self._sink_step if dataset_sink_mode else self._eager_step
+        for cb in callbacks:
+            cb.on_train_begin(self)
+        t0 = time.time()
+        counter = 0
+        for epoch in range(epochs):
+            if hasattr(dataset, "set_epoch"):
+                dataset.set_epoch(epoch)
+            loss = float("nan")
+            for images, labels in dataset:
+                self.state, metrics = step_fn(self.state, images, labels)
+                counter += 1
+                loss = float(metrics["loss"])
+                for cb in callbacks:
+                    cb.on_step_end(self, counter, loss)
+            for cb in callbacks:
+                cb.on_epoch_end(self, epoch, loss)
+        jax.block_until_ready(self.state.params)
+        self.train_time_s = time.time() - t0
+        for cb in callbacks:
+            cb.on_train_end(self)
+        return self
+
+    # ------------------------------------------------------------ inference
+
+    def predict(self, images) -> jax.Array:
+        logits, _ = self.network.apply(
+            self.state.params, self.state.model_state, jnp.asarray(images),
+            train=False,
+        )
+        return logits
+
+    def eval(self, dataset: Iterable) -> dict[str, float]:
+        """Metric-name → value over ``dataset`` (capitalized keys, as the
+        notebook prints e.g. {'Accuracy': 0.97})."""
+        totals = {m: 0.0 for m in self.metrics}
+        count = 0
+        for images, labels in dataset:
+            labels = jnp.asarray(labels)
+            logits = self.predict(images)
+            n = len(labels)
+            for m in self.metrics:
+                totals[m] += float(_METRIC_FNS[m](logits, labels)) * n
+            count += n
+        return {m.capitalize(): v / max(count, 1) for m, v in totals.items()}

@@ -59,6 +59,11 @@ class ArrayDataset:
             idx = np.arange(len(self))[idx]
         idx = np.asarray(idx)
         if idx.dtype == np.bool_:
+            if len(idx) != len(self):
+                raise IndexError(
+                    f"boolean mask length {len(idx)} does not match dataset "
+                    f"length {len(self)}"
+                )
             idx = np.nonzero(idx)[0]
         if idx.ndim == 0:
             imgs, lbls = self.gather(idx[None].astype(np.int64))
@@ -75,6 +80,11 @@ class ArrayDataset:
         else:
             imgs = native.gather_rows(self.images, idx)
         return imgs, native.gather_labels(self.labels, idx)
+
+
+def _check_storage(storage: str) -> None:
+    if storage not in ("u8", "f32"):
+        raise ValueError(f"storage must be 'u8' or 'f32', got {storage!r}")
 
 
 def _find_file(data_dir: Path, candidates: list[str]) -> Path | None:
@@ -123,8 +133,7 @@ def load_mnist(
     ``storage="u8"`` (default) keeps the raw bytes resident and fuses the
     /255 into batch gathering; ``"f32"`` converts at load time.
     """
-    if storage not in ("u8", "f32"):
-        raise ValueError(f"storage must be 'u8' or 'f32', got {storage!r}")
+    _check_storage(storage)
     data_dir = Path(data_dir)
     img_key = f"{split if split == 'train' else 'test'}_images"
     lbl_key = f"{split if split == 'train' else 'test'}_labels"
@@ -161,8 +170,7 @@ def load_cifar10(
 ) -> ArrayDataset:
     """CIFAR-10 python-pickle batches, NHWC in [0,1] (u8 storage defers the
     /255 to batch time, as in load_mnist)."""
-    if storage not in ("u8", "f32"):
-        raise ValueError(f"storage must be 'u8' or 'f32', got {storage!r}")
+    _check_storage(storage)
     data_dir = Path(data_dir)
     base = None
     for cand in (data_dir / "cifar-10-batches-py", data_dir):
@@ -219,12 +227,20 @@ def load_dataset(name: str, data_dir: str, split: str, **kw) -> ArrayDataset:
     if name == "cifar10":
         return load_cifar10(data_dir, split, **kw)
     if name == "synthetic":
-        storage = kw.pop("storage", "f32")  # synthetic data is generated f32
-        if storage not in ("u8", "f32"):
-            raise ValueError(f"storage must be 'u8' or 'f32', got {storage!r}")
+        storage = kw.pop("storage", "f32")
+        _check_storage(storage)
         n = kw.get("synthetic_size") or (4096 if split == "train" else 1024)
         imgs, labels = synthetic_classification(
             n, (28, 28, 1), 10, seed=0 if split == "train" else 1, proto_seed=100
         )
+        if storage == "u8":
+            # Honor the requested resident format: quantize the generated
+            # [0,1] floats to bytes, normalization deferred to gather.
+            return ArrayDataset(
+                np.ascontiguousarray((imgs * 255.0).round().astype(np.uint8)),
+                labels,
+                name=f"synthetic-{split}",
+                scale=1.0 / 255.0,
+            )
         return ArrayDataset(imgs, labels, name=f"synthetic-{split}")
     raise ValueError(f"unknown dataset {name!r}")

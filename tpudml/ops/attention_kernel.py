@@ -26,7 +26,6 @@ back to the reference math (``tpudml.nn.attention.dot_product_attention``).
 
 from __future__ import annotations
 
-import math
 from functools import partial
 
 import jax
@@ -36,20 +35,28 @@ from jax.experimental import pallas as pl
 from tpudml.nn.attention import NEG_INF, dot_product_attention
 
 
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
 def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, causal: bool,
-                 block_q: int):
+                 block_q: int, t_valid: int):
     q = q_ref[0]  # [block_q, D]
-    k = k_ref[0]  # [T, D]
-    v = v_ref[0]  # [T, D]
+    k = k_ref[0]  # [T_pad, D]
+    v = v_ref[0]  # [T_pad, D]
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale  # [block_q, T] on the MXU, f32 accumulation
+    ) * scale  # [block_q, T_pad] on the MXU, f32 accumulation
+    k_pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
     if causal:
         q_pos = pl.program_id(1) * block_q + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 0
         )
-        k_pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    if t_valid != s.shape[-1]:
+        # Sequence padded up to the block multiple: padded keys must not
+        # receive attention mass (padded Q rows are sliced off outside).
+        s = jnp.where(k_pos < t_valid, s, NEG_INF)
     m = jnp.max(s, axis=-1, keepdims=True)
     p = jnp.exp(s - m)
     l = jnp.sum(p, axis=-1, keepdims=True)
@@ -63,26 +70,32 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, causal: bool,
 def _flash_forward(q, k, v, causal: bool, block_q: int, interpret: bool):
     b, t, h, d = q.shape
     scale = 1.0 / (d ** 0.5)
-    # Auto-fit the Q block to the sequence: largest divisor of T that is
-    # ≤ the requested block (gcd), so any T works without padding. Odd T
-    # degrades granularity rather than erroring.
-    block_q = math.gcd(t, min(block_q, t))
-    # [B, T, H, D] → [B·H, T, D]: one grid row per (batch, head).
+    # Any T works: pad the sequence up to a block-multiple and mask the
+    # padded keys in-kernel (never shrink the block — a small block would
+    # silently waste the MXU's 8-sublane tiles on odd/prime T).
+    block_q = min(block_q, _round_up(t, 8))
+    t_pad = _round_up(t, block_q)
+    # [B, T, H, D] → [B·H, T_pad, D]: one grid row per (batch, head).
     fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
     qf, kf, vf = fold(q), fold(k), fold(v)
+    if t_pad != t:
+        pad = ((0, 0), (0, t_pad - t), (0, 0))
+        qf, kf, vf = (jnp.pad(a, pad) for a in (qf, kf, vf))
     out = pl.pallas_call(
-        partial(_attn_kernel, scale=scale, causal=causal, block_q=block_q),
+        partial(
+            _attn_kernel, scale=scale, causal=causal, block_q=block_q, t_valid=t
+        ),
         out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
-        grid=(b * h, t // block_q),
+        grid=(b * h, t_pad // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, t, d), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, t, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, t_pad, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, t_pad, d), lambda bh, i: (bh, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    return out[:, :t].reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
