@@ -1,0 +1,147 @@
+"""Task 5 — long-context transformer training (beyond reference parity).
+
+The reference has no sequence models (SURVEY.md §5.7), but long-context
+and distributed execution are first-class in this framework. This
+entrypoint trains a decoder-only TransformerLM on deterministic synthetic
+next-token data with a selectable parallelism/attention strategy:
+
+- ``--parallel single``  one chip, full or flash (Pallas) attention;
+- ``--parallel dp``      data parallel over a {"data": N} mesh;
+- ``--parallel cp``      ring-attention context parallelism — the sequence
+                         axis sharded over a {"seq": N} mesh, K/V blocks
+                         rotating on ICI (``--attn ulysses`` for the
+                         all-to-all variant);
+- ``--parallel tp``      Megatron-style tensor parallelism via GSPMD rules
+                         over a {"model": N} mesh.
+
+Reports steady-state tokens/sec and final loss.
+
+Run: ``python -m tasks.task5_longcontext --parallel cp --seq_len 512``
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import numpy as np
+
+from tpudml.core.config import MeshConfig
+from tpudml.core.dist import distributed_init, make_mesh
+from tpudml.core.prng import seed_key
+from tpudml.data.datasets import synthetic_lm
+from tpudml.metrics import MetricsWriter
+from tpudml.models import TransformerLM
+from tpudml.optim import make_optimizer
+from tpudml.parallel.cp import ContextParallel
+from tpudml.parallel.dp import DataParallel
+from tpudml.parallel.mp import GSPMDParallel, tensor_parallel_rules
+from tpudml.train import TrainState, make_train_step
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser()
+    p.add_argument("--parallel", choices=["single", "dp", "cp", "tp"], default="single")
+    p.add_argument("--attn", choices=["full", "flash", "ring", "ulysses"], default=None,
+                   help="attention impl; defaults: single/dp/tp=full, cp=ring")
+    p.add_argument("--n_devices", type=int, default=None)
+    p.add_argument("--seq_len", type=int, default=256)
+    p.add_argument("--batch_size", type=int, default=8, help="global batch (sequences)")
+    p.add_argument("--vocab", type=int, default=256)
+    p.add_argument("--embed_dim", type=int, default=128)
+    p.add_argument("--num_heads", type=int, default=8)
+    p.add_argument("--num_layers", type=int, default=2)
+    p.add_argument("--steps", type=int, default=60)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--log_every", type=int, default=20)
+    p.add_argument("--log_dir", type=str, default="./logs")
+    p.add_argument("--seed", type=int, default=0)
+    return p.parse_args(argv)
+
+
+def build_engine(args, devices):
+    n = len(devices)
+    base = dict(
+        vocab_size=args.vocab,
+        embed_dim=args.embed_dim,
+        num_heads=args.num_heads,
+        num_layers=args.num_layers,
+        max_len=args.seq_len,
+    )
+    opt = make_optimizer("adam", args.lr)
+    if args.parallel == "cp":
+        impl = args.attn or "ring"
+        if impl not in ("ring", "ulysses"):
+            raise ValueError("cp needs --attn ring|ulysses")
+        mesh = make_mesh(MeshConfig({"seq": n}), devices)
+        model = TransformerLM(**base, impl=impl, seq_sharded=True)
+        engine = ContextParallel(model, opt, mesh)
+        return model, engine.create_state(seed_key(args.seed)), engine.make_train_step()
+    impl = args.attn or "full"
+    if impl in ("ring", "ulysses"):
+        raise ValueError(f"--attn {impl} requires --parallel cp")
+    model = TransformerLM(**base, impl=impl)
+    if args.parallel == "single":
+        ts = TrainState.create(model, opt, seed_key(args.seed))
+        return model, ts, make_train_step(model, opt)
+    if args.parallel == "dp":
+        mesh = make_mesh(MeshConfig({"data": n}), devices)
+        engine = DataParallel(model, opt, mesh)
+        return model, engine.create_state(seed_key(args.seed)), engine.make_train_step()
+    # tp
+    mesh = make_mesh(MeshConfig({"model": n}), devices)
+    engine = GSPMDParallel(
+        model, opt, mesh, rule=tensor_parallel_rules("model"), axis_name="model"
+    )
+    return model, engine.create_state(seed_key(args.seed)), engine.make_train_step()
+
+
+def run(args) -> dict:
+    if args.steps < 1:
+        raise ValueError("--steps must be >= 1")
+    distributed_init()
+    devices = jax.devices()
+    if args.n_devices and args.parallel != "single":
+        devices = devices[: args.n_devices]
+    if args.parallel == "single":
+        devices = devices[:1]
+
+    seqs = synthetic_lm(args.batch_size * 4, args.seq_len, args.vocab, seed=args.seed)
+    model, ts, step = build_engine(args, devices)
+
+    writer = MetricsWriter(args.log_dir, run_name=f"task5-{args.parallel}")
+    rng = np.random.default_rng(args.seed)
+    t0 = None
+    loss = float("nan")
+    for i in range(1, args.steps + 1):
+        rows = rng.integers(0, len(seqs), size=args.batch_size)
+        batch = seqs[rows]
+        ts, metrics = step(ts, batch[:, :-1], batch[:, 1:])
+        if i == max(args.steps // 5, 1):  # steady state: past compile
+            jax.block_until_ready(metrics["loss"])
+            t0, steady_from = time.time(), i
+        if args.log_every and i % args.log_every == 0:
+            loss = float(metrics["loss"])
+            writer.add_scalar("Train Loss", loss, i)
+            print(f"step {i}: loss {loss:.4f}")
+    jax.block_until_ready(ts.params)
+    loss = float(metrics["loss"])
+    elapsed = time.time() - t0 if t0 else float("nan")
+    tokens = (args.steps - steady_from) * args.batch_size * args.seq_len
+    tok_per_s = tokens / elapsed if elapsed and elapsed > 0 else float("nan")
+    print(
+        f"[{args.parallel}/{args.attn or 'default'}] {len(devices)} device(s), "
+        f"T={args.seq_len}: {tok_per_s:,.0f} tokens/sec, final loss {loss:.4f}"
+    )
+    writer.add_scalar("Tokens Per Sec", tok_per_s, args.steps)
+    writer.close()
+    return {"tokens_per_sec": tok_per_s, "final_loss": loss, "devices": len(devices)}
+
+
+def main(argv=None):
+    return run(parse_args(argv))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,103 @@
+"""Tensor-parallel (Megatron-style GSPMD rules) tests.
+
+Load-bearing properties: TP shardings actually shard (params are placed
+on the model axis), the math is unchanged (training trajectory matches
+single-device), and TP composes with DP on a 2-D mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tpudml.core.config import MeshConfig
+from tpudml.core.dist import make_mesh
+from tpudml.core.prng import seed_key
+from tpudml.models import TransformerLM
+from tpudml.nn.losses import softmax_cross_entropy
+from tpudml.optim import make_optimizer
+from tpudml.parallel.mp import GSPMDParallel, apply_rules, tensor_parallel_rules
+
+B, T, V = 2, 16, 32
+BASE = dict(vocab_size=V, embed_dim=32, num_heads=4, num_layers=2, max_len=T)
+
+
+@pytest.fixture(scope="module")
+def batch():
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, V, size=(B, T + 1)).astype(np.int32))
+    return tokens[:, :-1], tokens[:, 1:]
+
+
+def test_rules_shard_the_right_dims():
+    model = TransformerLM(**BASE)
+    params, _ = model.init(seed_key(0))
+    mesh = make_mesh(MeshConfig({"model": 4}), jax.devices()[:4])
+    specs = apply_rules(tensor_parallel_rules("model"), params, mesh)
+    b0 = specs["block0"]
+    for n in ("q", "k", "v"):
+        assert b0["attn"][n]["kernel"] == P(None, "model")
+        assert b0["attn"][n]["bias"] == P("model")
+    assert b0["attn"]["out"]["kernel"] == P("model", None)
+    assert b0["fc1"]["kernel"] == P(None, "model")
+    assert b0["fc2"]["kernel"] == P("model", None)
+    assert specs["tok_embed"] == P("model", None)
+    assert specs["pos_embed"] == P()
+    assert specs["head"]["kernel"] == P(None, "model")
+    assert b0["ln1"]["scale"] == P()
+
+
+def test_tp_training_matches_single_device(batch):
+    x, y = batch
+    opt = make_optimizer("sgd", 0.1)
+    model = TransformerLM(**BASE)
+    mesh = make_mesh(MeshConfig({"model": 4}), jax.devices()[:4])
+    tp = GSPMDParallel(
+        model, opt, mesh, rule=tensor_parallel_rules("model"), axis_name="model"
+    )
+    ts = tp.create_state(seed_key(1))
+    # Params really live sharded on the model axis.
+    q_kernel = ts.params["block0"]["attn"]["q"]["kernel"]
+    assert q_kernel.sharding.spec == P(None, "model")
+
+    ref_params = jax.device_get(ts.params)
+    ref_opt = opt.init(ref_params)
+    ref_loss = lambda p: softmax_cross_entropy(model(p, x), y)
+    step = tp.make_train_step()
+    losses = []
+    for _ in range(3):
+        ts, m = step(ts, x, y)
+        losses.append(float(m["loss"]))
+        g = jax.grad(ref_loss)(ref_params)
+        ref_params, ref_opt = opt.update(g, ref_opt, ref_params)
+    for a, b in zip(jax.tree.leaves(ts.params), jax.tree.leaves(ref_params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-5)
+    assert losses[-1] < losses[0]
+
+
+def test_tp_composes_with_dp(batch):
+    x, y = batch
+    opt = make_optimizer("sgd", 0.1)
+    model = TransformerLM(**BASE)
+    mesh = make_mesh(MeshConfig({"data": 2, "model": 4}), jax.devices())
+    tp = GSPMDParallel(
+        model, opt, mesh,
+        rule=tensor_parallel_rules("model"),
+        axis_name="model",
+        batch_axis="data",
+    )
+    ts = tp.create_state(seed_key(2))
+    step = tp.make_train_step()
+    ts, m = step(ts, x, y)
+    assert int(ts.step) == 1 and np.isfinite(float(m["loss"]))
+
+    ref_model = TransformerLM(**BASE)
+    ref_params = jax.device_get(ts.params)  # after 1 step
+    # One more step on both paths must stay in lockstep.
+    ref_loss = lambda p: softmax_cross_entropy(ref_model(p, x), y)
+    g = jax.grad(ref_loss)(ref_params)
+    want, _ = opt.update(g, opt.init(ref_params), ref_params)
+    ts, _ = step(ts, x, y)
+    for a, b in zip(jax.tree.leaves(ts.params), jax.tree.leaves(want)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-5)

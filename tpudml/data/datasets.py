@@ -119,6 +119,27 @@ def synthetic_classification(
     return np.clip(imgs, 0.0, 1.0).astype(np.float32), labels
 
 
+def synthetic_lm(
+    n: int, seq_len: int, vocab: int, seed: int, noise: float = 0.0
+) -> np.ndarray:
+    """Deterministic next-token sequences: x[t+1] = π(x[t]) for a fixed
+    vocab permutation π (optionally corrupted with probability ``noise``).
+    A language model must learn π, so LM loss → 0 is achievable and
+    training-progress assertions stay meaningful — the sequence-modeling
+    analogue of :func:`synthetic_classification`. Returns [n, seq_len+1]
+    int32 tokens; slice [:, :-1] / [:, 1:] for inputs/targets."""
+    perm = np.random.default_rng(0xC0FFEE).permutation(vocab)
+    rng = np.random.default_rng(seed)
+    seqs = np.empty((n, seq_len + 1), np.int32)
+    seqs[:, 0] = rng.integers(0, vocab, size=n)
+    for t in range(seq_len):
+        seqs[:, t + 1] = perm[seqs[:, t]]
+    if noise:
+        corrupt = rng.random(seqs.shape) < noise
+        seqs = np.where(corrupt, rng.integers(0, vocab, size=seqs.shape), seqs)
+    return seqs.astype(np.int32)
+
+
 def load_mnist(
     data_dir: str = "./data",
     split: str = "train",

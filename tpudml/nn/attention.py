@@ -55,7 +55,8 @@ def dot_product_attention(
 
 @dataclass(frozen=True)
 class MultiHeadAttention(Module):
-    """Self-attention with fused QKV projection.
+    """Self-attention with separate head-aligned q/k/v projections (TP
+    shards each kernel's output dim without in-layer resharding).
 
     ``impl``: "full" (one-device softmax(QKᵀ)V), "flash" (Pallas fused
     kernel on TPU, reference math elsewhere — tpudml.ops), "ring"
@@ -78,10 +79,18 @@ class MultiHeadAttention(Module):
             )
 
     def init(self, key):
-        kq, ko = jax.random.split(key)
-        qkv = Dense(self.embed_dim, 3 * self.embed_dim, dtype=self.dtype)
-        out = Dense(self.embed_dim, self.embed_dim, dtype=self.dtype)
-        return {"qkv": qkv.init(kq)[0], "out": out.init(ko)[0]}, {}
+        # Separate q/k/v projections (not a fused [d, 3d] kernel): shards of
+        # each kernel's output dim stay head-aligned under tensor
+        # parallelism, so Megatron-style column sharding needs no in-layer
+        # resharding for any mesh size dividing num_heads.
+        kq, kk, kv, ko = jax.random.split(key, 4)
+        proj = Dense(self.embed_dim, self.embed_dim, dtype=self.dtype)
+        return {
+            "q": proj.init(kq)[0],
+            "k": proj.init(kk)[0],
+            "v": proj.init(kv)[0],
+            "out": proj.init(ko)[0],
+        }, {}
 
     def _heads(self, x):
         b, t, _ = x.shape
@@ -89,8 +98,10 @@ class MultiHeadAttention(Module):
 
     def apply(self, params, state, x, *, train=False, rng=None):
         b, t, _ = x.shape
-        qkv = x @ params["qkv"]["kernel"] + params["qkv"]["bias"]
-        q, k, v = (self._heads(a) for a in jnp.split(qkv, 3, axis=-1))
+        q, k, v = (
+            self._heads(x @ params[n]["kernel"] + params[n]["bias"])
+            for n in ("q", "k", "v")
+        )
         if self.impl == "full":
             o = dot_product_attention(q, k, v, causal=self.causal)
         elif self.impl == "flash":

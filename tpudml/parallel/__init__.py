@@ -17,7 +17,12 @@ from tpudml.parallel.sharding import (
 )
 from tpudml.parallel.cp import ContextParallel, ring_attention, ulysses_attention
 from tpudml.parallel.dp import DataParallel, make_dp_train_step
-from tpudml.parallel.mp import GSPMDParallel, apply_rules, stage_sharding_rules
+from tpudml.parallel.mp import (
+    GSPMDParallel,
+    apply_rules,
+    stage_sharding_rules,
+    tensor_parallel_rules,
+)
 from tpudml.parallel.pp import GPipe
 
 __all__ = [
@@ -26,6 +31,7 @@ __all__ = [
     "GPipe",
     "GSPMDParallel",
     "ring_attention",
+    "tensor_parallel_rules",
     "ulysses_attention",
     "make_dp_train_step",
     "apply_rules",

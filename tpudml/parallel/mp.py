@@ -75,6 +75,50 @@ def replicated_rules() -> RuleFn:
     return lambda path, leaf: P()
 
 
+def tensor_parallel_rules(axis_name: str = "model") -> RuleFn:
+    """Megatron-style intra-layer tensor parallelism for the transformer
+    family (beyond reference parity — SURVEY.md §2.3 lists TP as the
+    GSPMD-nearly-free stretch row).
+
+    Column-parallel then row-parallel pairs so each block needs one
+    all-reduce per sub-layer, which the XLA SPMD partitioner inserts from
+    the shardings alone: QKV and MLP-up kernels split on the output
+    (head/hidden) dimension, the attention-out and MLP-down kernels split
+    on the input dimension; embeddings split on vocab; norms replicated.
+    Non-transformer leaves fall back to the generic output-dim rule so the
+    rule set still works for mixed models.
+    """
+    generic = stage_sharding_rules(axis_name)
+
+    def rule(path: tuple, leaf) -> P:
+        names = set(path)
+        last2 = tuple(path[-2:]) if len(path) >= 2 else ()
+        if "attn" in names:
+            if last2 and last2[0] in ("q", "k", "v"):
+                # Column-parallel: output dim shards head-aligned (the
+                # projections are separate kernels, see MultiHeadAttention).
+                return P(None, axis_name) if last2[1] == "kernel" else P(axis_name)
+            if last2 == ("out", "kernel"):
+                return P(axis_name, None)  # row: contracted dim shard
+            return P()  # out bias (+ anything else) replicated
+        if last2 and last2[0] == "fc1":
+            return P(None, axis_name) if last2[1] == "kernel" else P(axis_name)
+        if last2 and last2[0] == "fc2":
+            return P(axis_name, None) if last2[1] == "kernel" else P()
+        if path and path[-1] == "tok_embed":
+            return P(axis_name, None)  # vocab shard
+        if path and path[-1] == "pos_embed":
+            return P()
+        if last2 and last2[0] == "head":
+            # LM head: column-parallel vocab projection.
+            return P(None, axis_name) if last2[1] == "kernel" else P(axis_name)
+        if "ln1" in names or "ln2" in names or "ln_f" in names:
+            return P()
+        return generic(path, leaf)
+
+    return rule
+
+
 def _path_names(key_path) -> tuple:
     names = []
     for k in key_path:
