@@ -23,6 +23,33 @@ def select_devices(cfg: TrainConfig) -> list:
     return devices
 
 
+def setup_checkpointing(cfg: TrainConfig, ts):
+    """(train_state, hooks, manager) per the config's checkpoint fields.
+
+    With ``--ckpt_dir`` set: ``--resume`` restores the latest checkpoint
+    into ``ts`` (every host reads the same files — the persistent form of
+    the reference's rank-0 parameter broadcast, SURVEY.md §5.4), and
+    ``--ckpt_every N`` installs a rolling-save train_loop hook. The caller
+    does the final save via the returned manager.
+    """
+    if not cfg.ckpt_dir:
+        return ts, [], None
+    from tpudml.checkpoint import CheckpointManager, checkpoint_hook
+
+    mgr = CheckpointManager(cfg.ckpt_dir)
+    if cfg.resume:
+        ts = mgr.restore_latest(ts)
+    hooks = [checkpoint_hook(mgr, cfg.ckpt_every)] if cfg.ckpt_every else []
+    return ts, hooks, mgr
+
+
+def final_checkpoint(mgr, ts) -> None:
+    """End-of-run save, skipped when the rolling hook already wrote this
+    exact step (avoids re-gathering + rewriting identical bytes)."""
+    if mgr is not None and mgr.latest_step() != int(ts.step):
+        mgr.save(ts, int(ts.step))
+
+
 def load_splits(cfg: TrainConfig):
     """(train, test) ArrayDatasets per the config's dataset selection."""
     train_set = load_dataset(

@@ -18,13 +18,15 @@ from __future__ import annotations
 
 import math
 
+from tasks.common import final_checkpoint, setup_checkpointing
 from tpudml.core.config import TrainConfig, build_parser, config_from_args
 from tpudml.core.prng import seed_key
 from tpudml.data import DataLoader, load_dataset
 from tpudml.metrics import MetricsWriter
+from tpudml.metrics.profiler import trace
 from tpudml.models import LeNet
 from tpudml.optim import make_optimizer
-from tpudml.train import evaluate, train_loop
+from tpudml.train import TrainState, evaluate, train_loop
 
 
 def reference_defaults() -> TrainConfig:
@@ -63,15 +65,21 @@ def run(cfg: TrainConfig) -> dict:
     model = LeNet(in_channels=train_set.images.shape[-1])
     optimizer = make_optimizer(cfg.optimizer, cfg.lr, cfg.momentum)
     writer = MetricsWriter(cfg.log_dir, run_name=f"task1-epoch{cfg.epochs}")
-    ts, metrics = train_loop(
-        model,
-        optimizer,
-        train_loader,
-        cfg.epochs,
-        seed_key(cfg.seed),
-        writer=writer,
-        log_every=cfg.log_every,
-    )
+    ts = TrainState.create(model, optimizer, seed_key(cfg.seed))
+    ts, hooks, ckpt_mgr = setup_checkpointing(cfg, ts)
+    with trace(writer.run_dir / "profile", enabled=cfg.profile):
+        ts, metrics = train_loop(
+            model,
+            optimizer,
+            train_loader,
+            cfg.epochs,
+            seed_key(cfg.seed),
+            writer=writer,
+            log_every=cfg.log_every,
+            state=ts,
+            hooks=hooks,
+        )
+    final_checkpoint(ckpt_mgr, ts)
     acc = evaluate(model, ts, test_loader)
     print(f"Test accuracy: {acc * 100:.2f}%")
     writer.add_scalar("Test Accuracy", acc, int(ts.step))

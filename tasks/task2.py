@@ -24,7 +24,13 @@ Run: ``python -m tasks.task2 [--aggregation allgather] [--measure_comm]
 from __future__ import annotations
 
 
-from tasks.common import load_splits, select_devices
+from tasks.common import (
+    final_checkpoint,
+    load_splits,
+    select_devices,
+    setup_checkpointing,
+)
+from tpudml.metrics.profiler import trace
 from tpudml.core.config import MeshConfig, TrainConfig, build_parser, config_from_args
 from tpudml.core.dist import distributed_init, make_mesh
 from tpudml.core.prng import seed_key
@@ -82,20 +88,24 @@ def run(cfg: TrainConfig) -> dict:
         bottleneck_delay_s=cfg.bottleneck_delay_s,
     )
     ts = dp.create_state(seed_key(cfg.seed))
+    ts, hooks, ckpt_mgr = setup_checkpointing(cfg, ts)
     step = dp.make_train_step()
 
     writer = MetricsWriter(cfg.log_dir, run_name=f"task2-{cfg.aggregation}-w{world}")
-    ts, metrics = train_loop(
-        model,
-        optimizer,
-        train_loader,
-        cfg.epochs,
-        seed_key(cfg.seed),
-        writer=writer,
-        log_every=cfg.log_every,
-        step_fn=step,
-        state=ts,
-    )
+    with trace(writer.run_dir / "profile", enabled=cfg.profile):
+        ts, metrics = train_loop(
+            model,
+            optimizer,
+            train_loader,
+            cfg.epochs,
+            seed_key(cfg.seed),
+            writer=writer,
+            log_every=cfg.log_every,
+            step_fn=step,
+            state=ts,
+            hooks=hooks,
+        )
+    final_checkpoint(ckpt_mgr, ts)
     if dp.comm_stats.calls:
         print(dp.comm_stats.report())  # reference print parity: model-mp.py:79
         writer.add_scalar("Comm Time", dp.comm_stats.comm_time_s, int(ts.step))

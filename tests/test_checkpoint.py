@@ -115,7 +115,9 @@ def test_hook_keys_by_global_step_across_resume(tmp_path, state):
     mgr = CheckpointManager(tmp_path, keep=2)
     hook = checkpoint_hook(mgr, every=2)
     hook(epoch=0, step=100, train_state=_at_step(ts, 100), metrics={})
-    # "Restart": loop counter back to 1..4, global step continues 101..104.
+    # "Restart" = a fresh process creates a fresh hook; its loop counter
+    # restarts at 1 while the restored global step continues at 101.
+    hook = checkpoint_hook(mgr, every=2)
     for counter, global_step in enumerate(range(101, 105), start=1):
         hook(epoch=0, step=counter, train_state=_at_step(ts, global_step), metrics={})
     assert mgr.latest_step() == 104

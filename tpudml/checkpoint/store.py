@@ -208,13 +208,20 @@ def checkpoint_hook(manager: CheckpointManager, every: int) -> Callable:
     """``train_loop`` hook: save the TrainState every ``every`` optimizer
     steps (host-side; does not interrupt the compiled step).
 
-    Saves are keyed by the TrainState's own monotonic ``step`` counter —
-    not the loop-local iteration count, which restarts at 0 on resume and
-    would let retention prune the new checkpoints in favour of stale ones.
+    Saves are keyed by the TrainState's monotonic ``step`` counter — not a
+    loop-local count that restarts on resume (which would let retention
+    prune new checkpoints in favour of stale ones). The device step is
+    synced ONCE (first call) to learn the offset from the loop counter;
+    after that the hook is pure host arithmetic, preserving the training
+    loop's async dispatch on the iterations that don't save.
     """
+    base: int | None = None
 
     def hook(*, epoch, step, train_state, metrics, **_):
-        global_step = int(train_state.step)
+        nonlocal base
+        if base is None:
+            base = int(train_state.step) - step
+        global_step = base + step
         if every and global_step % every == 0:
             manager.save(train_state, global_step, metadata={"epoch": epoch})
 

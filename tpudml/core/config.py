@@ -118,6 +118,10 @@ class TrainConfig:
     bottleneck_delay_s: float = 0.1  # reference: model-mp.py:47
     measure_comm: bool = False  # split-step comm-time accounting mode
     log_dir: str = "./logs"
+    profile: bool = False  # capture a jax.profiler trace into the run dir
+    ckpt_dir: str | None = None  # enable checkpointing under this directory
+    ckpt_every: int = 0  # steps between rolling checkpoints (0 = end only)
+    resume: bool = False  # restore the latest checkpoint before training
     seed: int = 0
     dist: DistributedConfig = field(default_factory=DistributedConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)

@@ -1,0 +1,84 @@
+"""Tracing / profiling (SURVEY.md §5.1).
+
+The reference measures performance with inline ``time.time()`` spans and
+recommends ``torch.cuda.Event`` timing (codes/task2/model-mp.py:48-79,
+sections/task2.tex:69-80); it has no profiler. Here both layers exist:
+
+- :func:`trace` captures an XLA/TPU profile via ``jax.profiler`` into the
+  run directory — open in TensorBoard (or Perfetto) to see per-op device
+  time, fusion boundaries, and collective overlap; the TPU-accurate
+  answer to "where did the step time go".
+- :class:`SpanTimer` is the host-side wall-clock layer (the model-mp.py
+  accounting, device-synchronized like the ``torch.cuda.Event`` recipe):
+  named spans with totals/counts, e.g. ``step`` vs ``comm``.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from pathlib import Path
+from typing import Iterator
+
+import jax
+
+
+@contextmanager
+def trace(log_dir: str | Path, enabled: bool = True) -> Iterator[None]:
+    """Capture a jax.profiler trace under ``log_dir`` (no-op when
+    ``enabled`` is False, so call sites can pass a config flag through)."""
+    if not enabled:
+        yield
+        return
+    with jax.profiler.trace(str(log_dir)):
+        yield
+
+
+def annotate(name: str):
+    """Label a host-side region so it shows up on the trace timeline
+    (thin alias of ``jax.profiler.TraceAnnotation``)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+class SpanTimer:
+    """Named wall-clock spans with device synchronization.
+
+    ``sync=`` values are blocked on (``jax.block_until_ready``) before the
+    span closes, so async-dispatched XLA work is charged to the span that
+    launched it — the semantic of the reference's cuda-Event timing
+    (sections/task2.tex:72-80).
+
+    Usage::
+
+        timer = SpanTimer()
+        with timer.span("step", sync=metrics["loss"]):
+            ts, metrics = step(ts, x, y)
+        print(timer.report())
+    """
+
+    def __init__(self):
+        self.totals: dict[str, float] = defaultdict(float)
+        self.counts: dict[str, int] = defaultdict(int)
+
+    @contextmanager
+    def span(self, name: str, sync=None) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            if sync is not None:
+                jax.block_until_ready(sync)
+            self.totals[name] += time.perf_counter() - t0
+            self.counts[name] += 1
+
+    def mean(self, name: str) -> float:
+        return self.totals[name] / max(self.counts[name], 1)
+
+    def report(self) -> str:
+        parts = [
+            f"{name}: {self.totals[name]:.4f}s over {self.counts[name]} calls "
+            f"(mean {self.mean(name) * 1e3:.2f}ms)"
+            for name in sorted(self.totals)
+        ]
+        return "\n".join(parts)

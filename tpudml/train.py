@@ -132,10 +132,18 @@ def train_loop(
     step = step_fn or make_train_step(
         model, optimizer, rng_root=jax.random.fold_in(key, 0x0D0)
     )
-    counter = 0
+    # Resume semantics: ``num_epochs`` is the TOTAL budget. A restored
+    # state (step > 0) skips the epochs already completed — same sampler
+    # epochs, same step-derived dropout streams — so a preempted+resumed
+    # run finishes the configured budget instead of re-training it.
+    # Granularity is whole epochs: a partially-trained epoch is redone
+    # from its start. (One host sync here, before the loop — not per step.)
+    counter = start_step = int(ts.step)
+    steps_per_epoch = len(train_loader) if hasattr(train_loader, "__len__") else 0
+    start_epoch = min(start_step // steps_per_epoch, num_epochs) if steps_per_epoch else 0
     t0 = time.time()
     metrics = None  # device values; materialized to floats only on log/exit
-    for epoch in range(num_epochs):
+    for epoch in range(start_epoch, num_epochs):
         if hasattr(train_loader, "set_epoch"):
             train_loader.set_epoch(epoch)
         for images, labels in train_loader:
