@@ -12,6 +12,7 @@ from tpudml.nn.layers import (
     Sequential,
 )
 from tpudml.nn.attention import MultiHeadAttention, dot_product_attention
+from tpudml.nn.moe import MoELayer, load_balancing_loss
 
 __all__ = [
     "Module",
@@ -27,4 +28,6 @@ __all__ = [
     "Sequential",
     "MultiHeadAttention",
     "dot_product_attention",
+    "MoELayer",
+    "load_balancing_loss",
 ]

@@ -1,0 +1,124 @@
+"""Mixture-of-Experts layer with expert parallelism.
+
+Absent from the reference (SURVEY.md §2.3 lists EP/MoE as out of parity
+scope), built here to complete the parallelism matrix. TPU-first design —
+the GShard/Switch dense-dispatch formulation, not per-token gather loops:
+
+- top-1 routing with a static per-shard expert capacity C, so every shape
+  is fixed and XLA tiles the dispatch/combine einsums onto the MXU;
+- dispatch is a [G, E, C] one-hot tensor: ``expert_in = einsum(
+  'gec,gd->ecd')``, combine is its gate-weighted transpose — tokens past
+  capacity are dropped (combine weight 0), the standard Switch trade;
+- under expert parallelism (``axis_name`` set, run inside shard_map),
+  tokens AND experts are sharded over the same mesh axis: each shard
+  routes its local tokens, one ``all_to_all`` ships the [E, C, d] dispatch
+  to the owning experts, the local expert FFNs run, and the inverse
+  ``all_to_all`` returns outputs to the token owners. Communication is two
+  all_to_alls of C·d per expert — never the full activations.
+
+Routing gradients flow through the combine gate (straight-through on the
+argmax path); an auxiliary load-balancing loss is exposed via
+:func:`load_balancing_loss` for callers that want Switch-style balance
+pressure in their objective.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpudml.nn.layers import Module, _uniform_fan_in
+
+
+@dataclass(frozen=True)
+class MoELayer(Module):
+    """Top-1 (Switch) mixture-of-experts FFN over [..., embed_dim] inputs.
+
+    ``axis_name=None``: single-shard dense routing. ``axis_name="expert"``:
+    expert-parallel — must run under shard_map with tokens sharded over the
+    axis and ``num_experts`` divisible by the axis size.
+    """
+
+    embed_dim: int
+    num_experts: int
+    mlp_ratio: int = 4
+    capacity_factor: float = 1.25
+    axis_name: str | None = None
+    dtype: Any = jnp.float32
+
+    def init(self, key):
+        d, e, h = self.embed_dim, self.num_experts, self.mlp_ratio * self.embed_dim
+        kr, k1, kb1, k2, kb2 = jax.random.split(key, 5)
+        params = {
+            "router": {"kernel": _uniform_fan_in(kr, (d, e), d, self.dtype)},
+            "experts": {
+                "w1": _uniform_fan_in(k1, (e, d, h), d, self.dtype),
+                "b1": _uniform_fan_in(kb1, (e, h), d, self.dtype),
+                "w2": _uniform_fan_in(k2, (e, h, d), h, self.dtype),
+                "b2": _uniform_fan_in(kb2, (e, d), h, self.dtype),
+            },
+        }
+        return params, {}
+
+    def _capacity(self, n_tokens: int) -> int:
+        return max(1, int(n_tokens * self.capacity_factor / self.num_experts + 0.5))
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        shape = x.shape
+        d, e = self.embed_dim, self.num_experts
+        g = 1
+        for s in shape[:-1]:
+            g *= s
+        tokens = x.reshape(g, d)
+        cap = self._capacity(g)
+
+        logits = tokens @ params["router"]["kernel"]  # [G, E]
+        probs = jax.nn.softmax(logits, axis=-1)
+        expert = jnp.argmax(probs, axis=-1)  # [G]
+        gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]  # [G]
+        onehot = jax.nn.one_hot(expert, e, dtype=tokens.dtype)  # [G, E]
+        # Position of each token within its expert's capacity buffer.
+        pos = jnp.cumsum(onehot, axis=0) - onehot  # [G, E]
+        kept = onehot * (pos < cap)  # overflow dropped (Switch semantics)
+        disp = kept[:, :, None] * jax.nn.one_hot(
+            jnp.sum(pos * onehot, axis=-1).astype(jnp.int32), cap, dtype=tokens.dtype
+        )[:, None, :]  # [G, E, C]
+
+        expert_in = jnp.einsum("gec,gd->ecd", disp, tokens)  # [E, C, d]
+        ep = self.axis_name is not None
+        if ep:
+            # Ship each expert's buffer to its owning shard: [E, C, d] →
+            # [E/W, W·C, d] (and back after the FFN).
+            expert_in = lax.all_to_all(
+                expert_in, self.axis_name, split_axis=0, concat_axis=1, tiled=True
+            )
+        w = params["experts"]
+        hidden = jax.nn.relu(
+            jnp.einsum("ecd,edh->ech", expert_in, w["w1"]) + w["b1"][:, None, :]
+        )
+        expert_out = (
+            jnp.einsum("ech,ehd->ecd", hidden, w["w2"]) + w["b2"][:, None, :]
+        )
+        if ep:
+            expert_out = lax.all_to_all(
+                expert_out, self.axis_name, split_axis=1, concat_axis=0, tiled=True
+            )
+        combine = disp * gate[:, None, None]
+        y = jnp.einsum("gec,ecd->gd", combine, expert_out)
+        return y.reshape(shape), state
+
+
+def load_balancing_loss(params: dict, x: jax.Array, num_experts: int) -> jax.Array:
+    """Switch-style auxiliary loss: E · Σ_e fraction_e · mean_prob_e —
+    minimized (→1) when routing is uniform. Add ``α·aux`` to the training
+    objective (α ≈ 0.01) to keep experts load-balanced."""
+    tokens = x.reshape(-1, x.shape[-1])
+    probs = jax.nn.softmax(tokens @ params["router"]["kernel"], axis=-1)
+    frac = jnp.mean(
+        jax.nn.one_hot(jnp.argmax(probs, -1), num_experts, dtype=probs.dtype), axis=0
+    )
+    return num_experts * jnp.sum(frac * jnp.mean(probs, axis=0))

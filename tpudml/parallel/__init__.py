@@ -17,6 +17,7 @@ from tpudml.parallel.sharding import (
 )
 from tpudml.parallel.cp import ContextParallel, ring_attention, ulysses_attention
 from tpudml.parallel.dp import DataParallel, make_dp_train_step
+from tpudml.parallel.ep import ExpertParallel, expert_specs
 from tpudml.parallel.mp import (
     GSPMDParallel,
     apply_rules,
@@ -28,6 +29,8 @@ from tpudml.parallel.pp import GPipe
 __all__ = [
     "ContextParallel",
     "DataParallel",
+    "ExpertParallel",
+    "expert_specs",
     "GPipe",
     "GSPMDParallel",
     "ring_attention",

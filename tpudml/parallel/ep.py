@@ -1,0 +1,167 @@
+"""Expert parallelism: training engine for MoE models.
+
+Tokens and experts shard over the same mesh axis (the GShard layout):
+each shard routes its own tokens, MoE layers ship capacity buffers by
+``all_to_all`` (see ``tpudml.nn.moe``), and parameters split into two
+gradient classes —
+
+- **expert parameters** (any leaf under an ``"experts"`` key): already
+  receive the cross-shard sum of cotangents through the all_to_all
+  transpose, so the engine only divides by the axis size to turn the sum
+  into the global-mean gradient;
+- **everything else** (router, embeddings, dense layers): replicated,
+  per-shard gradients are pmean-ed, exactly like data parallelism.
+
+The parity oracle (tests): EP training over W shards matches dense
+single-device training on the concatenated batch, step for step, when no
+capacity drops occur.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpudml.nn.layers import Module
+from tpudml.nn.losses import accuracy
+from tpudml.optim import Optimizer
+from tpudml.parallel.sharding import serialize_dispatch, shard_map_fn
+from tpudml.train import TrainState, make_loss_fn
+
+PyTree = Any
+
+
+def _is_expert_path(key_path) -> bool:
+    for k in key_path:
+        name = getattr(k, "key", getattr(k, "name", None))
+        if name == "experts":
+            return True
+    return False
+
+
+def expert_specs(params: PyTree, axis_name: str) -> PyTree:
+    """Per-leaf PartitionSpec: expert leaves shard their stacked leading
+    (num_experts) dim over the axis; everything else is replicated."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: P(axis_name) if _is_expert_path(path) else P(),
+        params,
+    )
+
+
+class ExpertParallel:
+    """EP training engine over a mesh ``expert`` axis.
+
+    The model must build its MoE layers with ``axis_name`` equal to this
+    engine's axis (e.g. ``MoELayer(..., axis_name="expert")``); batches
+    are global and get sharded over the axis by the step function.
+    """
+
+    def __init__(
+        self,
+        model: Module,
+        optimizer: Optimizer,
+        mesh: Mesh,
+        axis_name: str = "expert",
+    ):
+        self.model = model
+        self.optimizer = optimizer
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.world = mesh.shape[axis_name]
+        self._loss_fn = make_loss_fn(model)
+        self._sync_each_step = serialize_dispatch(mesh)
+        # Specs derive from the model structure alone (eval_shape — no
+        # compute), so step functions can be built before/without
+        # create_state, e.g. when restoring a checkpointed TrainState.
+        abstract = jax.eval_shape(
+            lambda: TrainState.create(self.model, self.optimizer, jax.random.key(0))
+        )
+        param_specs = expert_specs(abstract.params, axis_name)
+        self._specs = TrainState(
+            params=param_specs,
+            model_state=expert_specs(abstract.model_state, axis_name),
+            opt_state=self.optimizer.init_spec(param_specs),
+            step=P(),
+        )
+
+    def create_state(self, key: jax.Array) -> TrainState:
+        ts = TrainState.create(self.model, self.optimizer, key)
+        shardings = jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s),
+            self._specs,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        return jax.device_put(ts, shardings)
+
+    def _mean_grads(self, grads: PyTree) -> PyTree:
+        axis, world = self.axis_name, self.world
+
+        def fix(path, g):
+            if _is_expert_path(path):
+                return g / world  # a2a transpose already summed across shards
+            return lax.pmean(g, axis)
+
+        return jax.tree_util.tree_map_with_path(fix, grads)
+
+    def make_forward(self) -> Callable:
+        fwd = shard_map_fn(
+            lambda params, x: self.model(params, x),
+            self.mesh,
+            in_specs=(self._specs.params, P(self.axis_name)),
+            out_specs=P(self.axis_name),
+        )
+        return jax.jit(fwd)
+
+    def make_train_step(self) -> Callable:
+        axis = self.axis_name
+
+        def spmd(ts: TrainState, x, labels):
+            def loss_fn(params):
+                loss, aux = self._loss_fn(params, ts.model_state, x, labels, None)
+                return loss, aux
+
+            (loss, (model_state, logits)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(ts.params)
+            grads = self._mean_grads(grads)
+            # Replicated (non-expert) model state, e.g. BN stats, must stay
+            # shard-consistent — same treatment as the DP/CP engines;
+            # expert-owned state stays local to its shard.
+            model_state = jax.tree_util.tree_map_with_path(
+                lambda path, s: s if _is_expert_path(path) else lax.pmean(s, axis),
+                model_state,
+            )
+            new_params, new_opt = self.optimizer.update(grads, ts.opt_state, ts.params)
+            metrics = {
+                "loss": lax.pmean(loss, axis),
+                "accuracy": lax.pmean(accuracy(logits, labels), axis),
+            }
+            new_ts = TrainState(
+                params=new_params,
+                model_state=model_state,
+                opt_state=new_opt,
+                step=ts.step + 1,
+            )
+            return new_ts, metrics
+
+        specs = self._specs
+        jitted = jax.jit(
+            shard_map_fn(
+                spmd,
+                self.mesh,
+                in_specs=(specs, P(axis), P(axis)),
+                out_specs=(specs, P()),
+            )
+        )
+
+        def step(ts: TrainState, x, labels):
+            out = jitted(ts, jnp.asarray(x), jnp.asarray(labels))
+            if self._sync_each_step:
+                jax.block_until_ready(out[1]["loss"])
+            return out
+
+        return step
