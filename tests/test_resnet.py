@@ -64,7 +64,8 @@ def test_learns_synthetic_cifar():
     images, labels = jnp.asarray(images), jnp.asarray(labels)
     step = make_train_step(model, opt)
     ts = TrainState.create(model, opt, seed_key(0))
-    _, m0 = step(ts, images, labels)
+    # The step donates its input state — always rebind ts.
+    ts, m0 = step(ts, images, labels)
     for _ in range(15):
         ts, m = step(ts, images, labels)
     assert float(m["loss"]) < float(m0["loss"])
