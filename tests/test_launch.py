@@ -73,6 +73,34 @@ def test_timeout():
     assert result.elapsed_s < 20
 
 
+def test_restart_policy_recovers(tmp_path):
+    """Elastic recovery: a job that crashes once succeeds on relaunch
+    (the crash-marker file makes attempt 1 fail, attempt 2 pass)."""
+    marker = tmp_path / "crashed-once"
+    sink = io.StringIO()
+    spec = ClusterSpec(num_processes=2, max_restarts=2, grace_s=2.0)
+    code = (
+        "import os, sys;"
+        "m = " + repr(str(marker)) + " + '.{rank}';"  # per-rank marker
+        "crashed = os.path.exists(m);"
+        "open(m, 'w').close();"
+        "sys.exit(0 if crashed or {rank} == 0 else 3)"
+    )
+    result = launch([PY, "-c", code], spec, sink=sink)
+    assert result.success, sink.getvalue()
+    assert result.attempts == 2
+    assert "restart 1/2" in sink.getvalue()
+
+
+def test_restart_policy_gives_up(tmp_path):
+    spec = ClusterSpec(num_processes=1, max_restarts=2, grace_s=1.0)
+    sink = io.StringIO()
+    result = launch([PY, "-c", "import sys; sys.exit(7)"], spec, sink=sink)
+    assert not result.success
+    assert result.attempts == 3  # initial + 2 restarts
+    assert result.returncodes == [7]
+
+
 def test_two_process_collective_job():
     """End-to-end: 2 ranks initialize jax.distributed via the env contract,
     form a global 2-device mesh, and psum across process boundaries."""

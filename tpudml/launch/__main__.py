@@ -46,6 +46,9 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--timeout_s", type=float, default=None)
     p.add_argument("--bottleneck_rank", type=int, default=None)
     p.add_argument("--bottleneck_delay_s", type=float, default=None)
+    p.add_argument("--max_restarts", type=int, default=None,
+                   help="relaunch a failed job up to N times (pair the "
+                        "command with --ckpt_dir/--resume to continue)")
     args = p.parse_args(argv)
     if not cmd:
         p.error("no command given; usage: python -m tpudml.launch [opts] -- cmd ...")
@@ -60,6 +63,7 @@ def main(argv: list[str] | None = None) -> int:
         "timeout_s",
         "bottleneck_rank",
         "bottleneck_delay_s",
+        "max_restarts",
     ):
         val = getattr(args, name)
         if val is not None:

@@ -35,6 +35,11 @@ class ClusterSpec:
     devices_per_process: int = 1  # virtual host devices per rank (cpu sim)
     timeout_s: float | None = None  # whole-job wall-clock limit
     grace_s: float = 5.0  # SIGTERM → SIGKILL escalation delay
+    # Elastic recovery: relaunch the whole job after a failure/timeout up
+    # to this many times. Pair the command with --ckpt_dir/--resume so
+    # each restart continues from the last checkpoint (SURVEY.md §5.3/5.4:
+    # checkpoint/restart IS the recovery story).
+    max_restarts: int = 0
     # Straggler/fault injection (task2 bottleneck-node experiment).
     bottleneck_rank: int | None = None
     bottleneck_delay_s: float = 0.1

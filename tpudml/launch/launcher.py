@@ -2,12 +2,13 @@
 
 from __future__ import annotations
 
+import dataclasses
 import signal
 import subprocess
 import sys
 import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 from tpudml.launch.cluster import ClusterSpec
 
@@ -20,6 +21,7 @@ class LaunchResult:
     elapsed_s: float
     timed_out: bool = False
     failed_rank: int | None = None
+    attempts: int = 1
 
     @property
     def success(self) -> bool:
@@ -60,9 +62,50 @@ def launch(
     synchronous collectives one dead rank leaves every other rank blocked
     forever): the first rank to exit non-zero triggers SIGTERM (then
     SIGKILL after ``grace_s``) of the whole job; ``timeout_s`` bounds total
-    wall clock the same way.
+    wall clock the same way. With ``spec.max_restarts`` > 0 a failed or
+    timed-out job is relaunched whole (fresh rendezvous port) up to that
+    many times — combine with the tasks' ``--ckpt_dir ... --resume`` flags
+    so restarts continue from the last checkpoint. ``attempts`` on the
+    result counts the runs.
     """
     spec = spec or ClusterSpec()
+    out = sink or sys.stdout
+    # Each attempt runs on a COPY of the spec: an auto-picked rendezvous
+    # port (coordinator_port=0) is re-picked per attempt, an explicitly
+    # configured port is kept; the caller's spec is never mutated.
+    auto_port = spec.coordinator_port == 0
+    budget = spec.timeout_s  # whole-job wall clock, spent across attempts
+
+    def attempt_spec(remaining: float | None) -> ClusterSpec:
+        return dataclasses.replace(
+            spec,
+            coordinator_port=0 if auto_port else spec.coordinator_port,
+            timeout_s=remaining,
+        )
+
+    result = _launch_once(cmd, attempt_spec(budget), sink)
+    total_elapsed = result.elapsed_s
+    attempt = 1
+    while not result.success and attempt <= spec.max_restarts:
+        remaining = None if budget is None else budget - total_elapsed
+        if remaining is not None and remaining <= 0:
+            break  # whole-job budget exhausted — don't relaunch
+        why = "timeout" if result.timed_out else f"rank {result.failed_rank} failed"
+        out.write(f"[launch] {why}; restart {attempt}/{spec.max_restarts}\n")
+        out.flush()
+        result = _launch_once(cmd, attempt_spec(remaining), sink)
+        total_elapsed += result.elapsed_s
+        attempt += 1
+    result.attempts = attempt
+    result.elapsed_s = total_elapsed
+    return result
+
+
+def _launch_once(
+    cmd: list[str],
+    spec: ClusterSpec,
+    sink=None,
+) -> LaunchResult:
     sink = sink or sys.stdout
     world = spec.num_processes
     spec.coordinator_address()  # resolve the port once, before any spawn
