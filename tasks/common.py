@@ -23,6 +23,18 @@ def select_devices(cfg: TrainConfig) -> list:
     return devices
 
 
+def init_distributed(cfg: TrainConfig) -> None:
+    """Multi-process init + same-program guard, in one place so no
+    entrypoint can forget the guard: after the rendezvous, every process
+    allgathers a hash of its rank-invariant config and fails fast on
+    divergence (SURVEY.md §5.2 — a mismatched rank would otherwise
+    deadlock in the first collective)."""
+    from tpudml.core.dist import assert_same_program, distributed_init
+
+    distributed_init(cfg.dist)
+    assert_same_program(cfg.fingerprint(), "task config")
+
+
 def setup_checkpointing(cfg: TrainConfig, ts):
     """(train_state, hooks, manager) per the config's checkpoint fields.
 

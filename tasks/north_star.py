@@ -18,9 +18,9 @@ import time
 
 import jax.numpy as jnp
 
-from tasks.common import load_splits, select_devices
+from tasks.common import init_distributed, load_splits, select_devices
 from tpudml.core.config import MeshConfig, TrainConfig, build_parser, config_from_args
-from tpudml.core.dist import distributed_init, make_mesh
+from tpudml.core.dist import make_mesh
 from tpudml.core.prng import seed_key
 from tpudml.data import DataLoader, ShardedDataLoader
 from tpudml.data.sampler import make_sampler
@@ -43,7 +43,7 @@ def reference_defaults() -> TrainConfig:
 
 
 def run(cfg: TrainConfig, compute_dtype=jnp.bfloat16) -> dict:
-    distributed_init(cfg.dist)
+    init_distributed(cfg)
     devices = select_devices(cfg)
     mesh = make_mesh(MeshConfig({"data": len(devices)}), devices)
     world = mesh.shape["data"]

@@ -26,13 +26,14 @@ from __future__ import annotations
 
 from tasks.common import (
     final_checkpoint,
+    init_distributed,
     load_splits,
     select_devices,
     setup_checkpointing,
 )
 from tpudml.metrics.profiler import trace
 from tpudml.core.config import MeshConfig, TrainConfig, build_parser, config_from_args
-from tpudml.core.dist import distributed_init, make_mesh
+from tpudml.core.dist import make_mesh
 from tpudml.core.prng import seed_key
 from tpudml.data import DataLoader, ShardedDataLoader
 from tpudml.data.sampler import make_sampler
@@ -54,7 +55,7 @@ def reference_defaults() -> TrainConfig:
 
 
 def run(cfg: TrainConfig) -> dict:
-    distributed_init(cfg.dist)
+    init_distributed(cfg)
     devices = select_devices(cfg)
     mesh = make_mesh(MeshConfig({"data": len(devices)}), devices)
     world = mesh.shape["data"]

@@ -24,9 +24,9 @@ simulated meshes, or TPU slices.)
 from __future__ import annotations
 
 
-from tasks.common import load_splits, select_devices
+from tasks.common import init_distributed, load_splits, select_devices
 from tpudml.core.config import MeshConfig, TrainConfig, build_parser, config_from_args
-from tpudml.core.dist import distributed_init, make_mesh
+from tpudml.core.dist import make_mesh
 from tpudml.core.prng import seed_key
 from tpudml.data import DataLoader
 from tpudml.data.sampler import make_sampler
@@ -48,7 +48,7 @@ def reference_defaults() -> TrainConfig:
 
 
 def run(cfg: TrainConfig) -> dict:
-    distributed_init(cfg.dist)
+    init_distributed(cfg)
     devices = select_devices(cfg)
     mesh = make_mesh(MeshConfig({"stage": len(devices)}), devices)
     world = mesh.shape["stage"]

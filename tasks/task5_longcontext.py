@@ -12,7 +12,10 @@ next-token data with a selectable parallelism/attention strategy:
                          rotating on ICI (``--attn ulysses`` for the
                          all-to-all variant);
 - ``--parallel tp``      Megatron-style tensor parallelism via GSPMD rules
-                         over a {"model": N} mesh.
+                         over a {"model": N} mesh;
+- ``--parallel pp``      micro-batched pipeline (GPipe) — one decoder block
+                         per stage over a {"stage": N} mesh (depth = N;
+                         ``--num_layers`` is ignored in this mode).
 
 Reports steady-state tokens/sec and final loss.
 
@@ -28,7 +31,7 @@ import jax
 import numpy as np
 
 from tpudml.core.config import MeshConfig
-from tpudml.core.dist import distributed_init, make_mesh
+from tpudml.core.dist import assert_same_program, distributed_init, make_mesh
 from tpudml.core.prng import seed_key
 from tpudml.data.datasets import synthetic_lm
 from tpudml.metrics import MetricsWriter
@@ -42,7 +45,10 @@ from tpudml.train import TrainState, make_train_step
 
 def parse_args(argv=None) -> argparse.Namespace:
     p = argparse.ArgumentParser()
-    p.add_argument("--parallel", choices=["single", "dp", "cp", "tp"], default="single")
+    p.add_argument(
+        "--parallel", choices=["single", "dp", "cp", "tp", "pp"], default="single"
+    )
+    p.add_argument("--microbatches", type=int, default=4, help="pp micro-batches")
     p.add_argument("--attn", choices=["full", "flash", "ring", "ulysses"], default=None,
                    help="attention impl; defaults: single/dp/tp=full, cp=ring")
     p.add_argument("--n_devices", type=int, default=None)
@@ -61,6 +67,7 @@ def parse_args(argv=None) -> argparse.Namespace:
 
 
 def build_engine(args, devices):
+    """(train_state, step_fn) for the selected strategy."""
     n = len(devices)
     base = dict(
         vocab_size=args.vocab,
@@ -77,30 +84,47 @@ def build_engine(args, devices):
         mesh = make_mesh(MeshConfig({"seq": n}), devices)
         model = TransformerLM(**base, impl=impl, seq_sharded=True)
         engine = ContextParallel(model, opt, mesh)
-        return model, engine.create_state(seed_key(args.seed)), engine.make_train_step()
+        return engine.create_state(seed_key(args.seed)), engine.make_train_step()
     impl = args.attn or "full"
     if impl in ("ring", "ulysses"):
         raise ValueError(f"--attn {impl} requires --parallel cp")
     model = TransformerLM(**base, impl=impl)
     if args.parallel == "single":
         ts = TrainState.create(model, opt, seed_key(args.seed))
-        return model, ts, make_train_step(model, opt)
+        return ts, make_train_step(model, opt)
     if args.parallel == "dp":
         mesh = make_mesh(MeshConfig({"data": n}), devices)
         engine = DataParallel(model, opt, mesh)
-        return model, engine.create_state(seed_key(args.seed)), engine.make_train_step()
+        return engine.create_state(seed_key(args.seed)), engine.make_train_step()
+    if args.parallel == "pp":
+        # One decoder block per pipeline stage; embed/head replicated.
+        from tpudml.models import TransformerBlock, TransformerEmbed, TransformerHead
+        from tpudml.parallel.pp import GPipe
+
+        mesh = make_mesh(MeshConfig({"stage": n}), devices)
+        pipe = GPipe(
+            TransformerBlock(args.embed_dim, args.num_heads, causal=True, impl=impl),
+            n_microbatches=args.microbatches,
+            mesh=mesh,
+            optimizer=opt,
+            prologue=TransformerEmbed(args.vocab, args.embed_dim, args.seq_len),
+            epilogue=TransformerHead(args.embed_dim, args.vocab),
+        )
+        return pipe.create_state(seed_key(args.seed)), pipe.make_train_step()
     # tp
     mesh = make_mesh(MeshConfig({"model": n}), devices)
     engine = GSPMDParallel(
         model, opt, mesh, rule=tensor_parallel_rules("model"), axis_name="model"
     )
-    return model, engine.create_state(seed_key(args.seed)), engine.make_train_step()
+    return engine.create_state(seed_key(args.seed)), engine.make_train_step()
 
 
 def run(args) -> dict:
     if args.steps < 1:
         raise ValueError("--steps must be >= 1")
     distributed_init()
+    # Same-program guard (SURVEY.md §5.2): all ranks must agree on argv.
+    assert_same_program(repr(sorted(vars(args).items())), "task5 args")
     devices = jax.devices()
     if args.n_devices and args.parallel != "single":
         devices = devices[: args.n_devices]
@@ -108,7 +132,7 @@ def run(args) -> dict:
         devices = devices[:1]
 
     seqs = synthetic_lm(args.batch_size * 4, args.seq_len, args.vocab, seed=args.seed)
-    model, ts, step = build_engine(args, devices)
+    ts, step = build_engine(args, devices)
 
     writer = MetricsWriter(args.log_dir, run_name=f"task5-{args.parallel}")
     rng = np.random.default_rng(args.seed)

@@ -1,0 +1,13 @@
+"""Comm micro-benchmark tool smoke test (task2's strategy comparison)."""
+
+from tpudml.comm.bench import main
+
+
+def test_comm_bench_runs_all_strategies(capsys):
+    results = main(["--iters", "2", "--sizes", "4096", "--n_devices", "4"])
+    assert {r["strategy"] for r in results} == {
+        "allgather", "allreduce", "reducescatter",
+    }
+    assert all(r["mean_ms"] > 0 and r["world"] == 4 for r in results)
+    out = capsys.readouterr().out
+    assert "allreduce" in out and "4096" in out

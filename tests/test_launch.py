@@ -101,6 +101,24 @@ def test_restart_policy_gives_up(tmp_path):
     assert result.returncodes == [7]
 
 
+def test_same_program_check_catches_config_divergence(tmp_path):
+    """Ranks launched with different hyperparameters must fail fast with an
+    attributed error instead of deadlocking in the first collective
+    (SURVEY.md §5.2)."""
+    sink = io.StringIO()
+    spec = ClusterSpec(num_processes=2, timeout_s=240.0)
+    result = launch(
+        [PY, "-m", "tasks.task2", "--dataset", "synthetic", "--epochs", "1",
+         "--log_every", "0", "--n_devices", "2", "--lr", "0.0{rank}1"],
+        spec,
+        sink=sink,
+    )
+    out = sink.getvalue()
+    assert not result.success
+    assert "SPMD task config mismatch" in out
+    assert result.elapsed_s < 120
+
+
 def test_two_process_collective_job():
     """End-to-end: 2 ranks initialize jax.distributed via the env contract,
     form a global 2-device mesh, and psum across process boundaries."""

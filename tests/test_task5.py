@@ -34,8 +34,12 @@ COMMON = [
         ["--parallel", "cp", "--n_devices", "4"],
         ["--parallel", "cp", "--n_devices", "4", "--attn", "ulysses"],
         ["--parallel", "tp", "--n_devices", "4"],
+        # pp is one block PER STAGE (4 layers here vs 1 above) — the deeper
+        # model needs a few more steps to pass the same loss bar.
+        ["--parallel", "pp", "--n_devices", "4", "--microbatches", "4",
+         "--steps", "80"],
     ],
-    ids=["single", "dp", "cp-ring", "cp-ulysses", "tp"],
+    ids=["single", "dp", "cp-ring", "cp-ulysses", "tp", "pp"],
 )
 def test_strategies_learn_successor(extra):
     out = main(COMMON + extra)

@@ -1,0 +1,98 @@
+"""Collective aggregation micro-benchmark: ``python -m tpudml.comm.bench``.
+
+The task2 deliverable — "implement ≥2 collective aggregation strategies
+and compare their communication time" (sections/task2.tex:18,
+sections/checking.tex:20-21) — as a standalone tool: times each gradient
+aggregation strategy (allreduce / allgather / reducescatter) over
+configurable payload sizes on the current mesh and prints a comparison
+table plus one JSON line per (strategy, size).
+
+Methodology: the collective runs alone inside one jitted shard_map
+program (mirroring the engines' ``measure_comm`` split-step mode), timed
+host-side around ``block_until_ready`` — the reference's comm-span
+accounting (codes/task2/model-mp.py:61-66) without the training loop
+around it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from tpudml.comm.collectives import AGGREGATORS, get_aggregator
+from tpudml.core.config import MeshConfig
+from tpudml.core.dist import distributed_init, make_mesh
+from tpudml.parallel.sharding import shard_map_fn
+
+
+def bench_strategy(name: str, mesh, size: int, iters: int) -> dict:
+    agg = get_aggregator(name)
+    axis = mesh.axis_names[0]
+    fn = jax.jit(
+        shard_map_fn(
+            lambda t: agg(t, axis), mesh, in_specs=P(), out_specs=P()
+        )
+    )
+    payload = {"grad": jnp.ones((size,), jnp.float32)}
+    out = fn(payload)  # compile + warm
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(payload)
+    jax.block_until_ready(out)
+    mean_s = (time.perf_counter() - t0) / iters
+    return {
+        "strategy": name,
+        "elements": size,
+        "bytes": size * 4,
+        "world": mesh.devices.size,
+        "mean_ms": mean_s * 1e3,
+    }
+
+
+def main(argv=None) -> list[dict]:
+    p = argparse.ArgumentParser(prog="tpudml.comm.bench")
+    p.add_argument(
+        "--strategies", nargs="+", default=sorted(AGGREGATORS),
+        choices=sorted(AGGREGATORS),
+    )
+    p.add_argument(
+        "--sizes", nargs="+", type=int,
+        default=[1 << 14, 1 << 18, 1 << 22],
+        help="payload element counts (float32)",
+    )
+    p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--n_devices", type=int, default=None)
+    args = p.parse_args(argv)
+
+    distributed_init()
+    devices = jax.devices()
+    if args.n_devices:
+        devices = devices[: args.n_devices]
+    mesh = make_mesh(MeshConfig({"data": len(devices)}), devices)
+
+    results = []
+    for size in args.sizes:
+        for name in args.strategies:
+            rec = bench_strategy(name, mesh, size, args.iters)
+            results.append(rec)
+            print(json.dumps(rec))
+    # Human-readable comparison (the lab's analysis table).
+    print(f"\n{'elements':>10} | " + " | ".join(f"{n:>13}" for n in args.strategies))
+    for size in args.sizes:
+        row = [r for r in results if r["elements"] == size]
+        cells = {r["strategy"]: r["mean_ms"] for r in row}
+        print(
+            f"{size:>10} | "
+            + " | ".join(f"{cells[n]:>11.3f}ms" for n in args.strategies)
+        )
+    return results
+
+
+if __name__ == "__main__":
+    main()

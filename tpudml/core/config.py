@@ -127,6 +127,15 @@ class TrainConfig:
     mesh: MeshConfig = field(default_factory=MeshConfig)
     data: DataConfig = field(default_factory=DataConfig)
 
+    def fingerprint(self) -> str:
+        """Rank-invariant program identity for the cross-process
+        same-program check (``assert_same_program``): every field except
+        the per-process ``dist`` block and rank-targeted fault injection."""
+        d = dataclasses.asdict(self)
+        d.pop("dist", None)
+        d.pop("bottleneck_rank", None)
+        return repr(dict(sorted(d.items())))
+
 
 def _add_flag(
     parser: argparse.ArgumentParser, name: str, default: Any, annotation: str = ""

@@ -80,6 +80,40 @@ def local_device_count() -> int:
     return jax.local_device_count()
 
 
+def assert_same_program(fingerprint: str, tag: str = "program") -> None:
+    """Fail fast if processes are about to run different SPMD programs.
+
+    The reference's only concurrency safety is structural — all ranks call
+    the same collectives in the same order, and a mismatch (e.g. one rank
+    launched with different hyperparameters) hangs every rank in the
+    rendezvous forever (SURVEY.md §5.2). This is the launcher-level
+    same-program check that section calls for: every process allgathers a
+    hash of its program fingerprint (config, code version, …) and raises
+    on divergence BEFORE any training collective is issued, turning a
+    silent deadlock into an immediate, attributed error.
+
+    No-op in single-process runs.
+    """
+    if process_count() <= 1:
+        return
+    import hashlib
+
+    from jax.experimental import multihost_utils
+
+    digest = hashlib.sha256(fingerprint.encode()).digest()[:8]
+    mine = np.frombuffer(digest, dtype=np.int64)
+    everyone = np.asarray(multihost_utils.process_allgather(mine, tiled=True))
+    if not (everyone == everyone[0]).all():
+        bad = sorted(
+            int(i) for i in np.nonzero(everyone != everyone[0])[0]
+        )
+        raise RuntimeError(
+            f"SPMD {tag} mismatch: processes {bad} disagree with process 0 "
+            f"(this process={process_index()}). All ranks must run the same "
+            "program/config; a mismatch would deadlock in the first collective."
+        )
+
+
 def make_mesh(cfg: MeshConfig | None = None, devices=None) -> Mesh:
     """Build a named device Mesh from a MeshConfig.
 

@@ -2,7 +2,12 @@ from tpudml.models.lenet import LeNet
 from tpudml.models.mlp import ForwardMLP
 from tpudml.models.resnet import ResNet, ResNet18, ResNet34
 from tpudml.models.staged import StagedModel, lenet_stages
-from tpudml.models.transformer import TransformerBlock, TransformerLM
+from tpudml.models.transformer import (
+    TransformerBlock,
+    TransformerEmbed,
+    TransformerHead,
+    TransformerLM,
+)
 
 __all__ = [
     "LeNet",
@@ -13,5 +18,7 @@ __all__ = [
     "StagedModel",
     "lenet_stages",
     "TransformerBlock",
+    "TransformerEmbed",
+    "TransformerHead",
     "TransformerLM",
 ]

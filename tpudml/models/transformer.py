@@ -74,6 +74,70 @@ class TransformerBlock(Module):
 
 
 @dataclass(frozen=True)
+class TransformerEmbed(Module):
+    """Token + learned position embedding. Doubles as the pipeline
+    prologue (GPipe runs it replicated ahead of the staged trunk) and as
+    TransformerLM's embedding stage; with ``seq_sharded=True`` position
+    lookup uses the device's global offset along ``axis_name`` (run under
+    shard_map with the time axis sharded)."""
+
+    vocab_size: int
+    embed_dim: int
+    max_len: int = 1024
+    axis_name: str = "seq"
+    seq_sharded: bool = False
+    dtype: Any = jnp.float32
+
+    def init(self, key):
+        ke, kp = jax.random.split(key)
+        return {
+            "tok_embed": 0.02
+            * jax.random.normal(ke, (self.vocab_size, self.embed_dim), self.dtype),
+            "pos_embed": 0.02
+            * jax.random.normal(kp, (self.max_len, self.embed_dim), self.dtype),
+        }, {}
+
+    def apply(self, params, state, tokens, *, train=False, rng=None):
+        t_local = tokens.shape[1]
+        t_global = (
+            lax.axis_size(self.axis_name) * t_local if self.seq_sharded else t_local
+        )
+        if t_global > self.max_len:
+            # Trace-time guard: out-of-range gathers clamp silently under
+            # jit, which would reuse pos_embed[max_len-1] for the overflow
+            # and corrupt position information without any signal.
+            raise ValueError(
+                f"sequence length {t_global} exceeds max_len {self.max_len}"
+            )
+        offset = (
+            lax.axis_index(self.axis_name) * t_local if self.seq_sharded else 0
+        )
+        pos = offset + jnp.arange(t_local)
+        return params["tok_embed"][tokens] + params["pos_embed"][pos], state
+
+
+@dataclass(frozen=True)
+class TransformerHead(Module):
+    """Final LayerNorm + vocab projection — the pipeline epilogue."""
+
+    embed_dim: int
+    vocab_size: int
+    dtype: Any = jnp.float32
+
+    def init(self, key):
+        kl, kh = jax.random.split(key)
+        return {
+            "ln_f": LayerNorm(self.embed_dim, dtype=self.dtype).init(kl)[0],
+            "head": Dense(self.embed_dim, self.vocab_size, dtype=self.dtype).init(kh)[0],
+        }, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        h = LayerNorm(self.embed_dim, dtype=self.dtype)(params["ln_f"], x)
+        head = Dense(self.embed_dim, self.vocab_size, dtype=self.dtype)
+        return head(params["head"], h), state
+
+
+@dataclass(frozen=True)
 class TransformerLM(Module):
     """Decoder-only language model: token + learned position embeddings,
     N pre-LN blocks, final LayerNorm, vocab projection.
@@ -103,41 +167,39 @@ class TransformerLM(Module):
             dtype=self.dtype,
         )
 
+    # Composition: the LM IS embed → blocks → head, with the param tree
+    # kept FLAT (tok_embed/pos_embed/block{i}/ln_f/head) so checkpoints,
+    # TP sharding rules, and pipeline prologue/epilogue trees stay in one
+    # format regardless of which engine runs the model.
+
+    def _embed(self) -> TransformerEmbed:
+        return TransformerEmbed(
+            self.vocab_size,
+            self.embed_dim,
+            self.max_len,
+            axis_name=self.axis_name,
+            seq_sharded=self.seq_sharded,
+            dtype=self.dtype,
+        )
+
+    def _head(self) -> "TransformerHead":
+        return TransformerHead(self.embed_dim, self.vocab_size, dtype=self.dtype)
+
     def init(self, key):
-        ke, kp, kb, kl, kh = jax.random.split(key, 5)
-        d = self.embed_dim
-        params = {
-            "tok_embed": 0.02
-            * jax.random.normal(ke, (self.vocab_size, d), self.dtype),
-            "pos_embed": 0.02 * jax.random.normal(kp, (self.max_len, d), self.dtype),
-            "ln_f": LayerNorm(d, dtype=self.dtype).init(kl)[0],
-            "head": Dense(d, self.vocab_size, dtype=self.dtype).init(kh)[0],
-        }
+        ke, kb, kh = jax.random.split(key, 3)
+        params = dict(self._embed().init(ke)[0])
+        params.update(self._head().init(kh)[0])
         block = self._block()
         for i, k in enumerate(jax.random.split(kb, self.num_layers)):
             params[f"block{i}"] = block.init(k)[0]
         return params, {}
 
     def apply(self, params, state, tokens, *, train=False, rng=None):
-        t_local = tokens.shape[1]
-        t_global = (
-            lax.axis_size(self.axis_name) * t_local if self.seq_sharded else t_local
+        h = self._embed()(
+            {k: params[k] for k in ("tok_embed", "pos_embed")}, tokens
         )
-        if t_global > self.max_len:
-            # Trace-time guard: out-of-range gathers clamp silently under
-            # jit, which would reuse pos_embed[max_len-1] for the overflow
-            # and corrupt position information without any signal.
-            raise ValueError(
-                f"sequence length {t_global} exceeds max_len {self.max_len}"
-            )
-        offset = (
-            lax.axis_index(self.axis_name) * t_local if self.seq_sharded else 0
-        )
-        pos = offset + jnp.arange(t_local)
-        h = params["tok_embed"][tokens] + params["pos_embed"][pos]
         block = self._block()
         for i in range(self.num_layers):
             h, _ = block.apply(params[f"block{i}"], {}, h, train=train, rng=rng)
-        h = LayerNorm(self.embed_dim, dtype=self.dtype)(params["ln_f"], h)
-        head = Dense(self.embed_dim, self.vocab_size, dtype=self.dtype)
-        return head(params["head"], h), state
+        logits = self._head()({k: params[k] for k in ("ln_f", "head")}, h)
+        return logits, state
