@@ -83,6 +83,7 @@ def run(cfg: TrainConfig, compute_dtype=jnp.bfloat16) -> dict:
         log_every=cfg.log_every,
         step_fn=step,
         state=ts,
+        accum_steps=cfg.accum_steps,
     )
     train_time = time.time() - t0
     global_batch = cfg.data.batch_size * world

@@ -78,6 +78,7 @@ def run(cfg: TrainConfig) -> dict:
             log_every=cfg.log_every,
             state=ts,
             hooks=hooks,
+            accum_steps=cfg.accum_steps,
         )
     final_checkpoint(ckpt_mgr, ts)
     acc = evaluate(model, ts, test_loader)

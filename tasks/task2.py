@@ -87,6 +87,7 @@ def run(cfg: TrainConfig) -> dict:
         measure_comm=cfg.measure_comm or cfg.bottleneck_rank is not None,
         bottleneck_rank=cfg.bottleneck_rank,
         bottleneck_delay_s=cfg.bottleneck_delay_s,
+        accum_steps=cfg.accum_steps,
     )
     ts = dp.create_state(seed_key(cfg.seed))
     ts, hooks, ckpt_mgr = setup_checkpointing(cfg, ts)

@@ -75,6 +75,7 @@ def run(cfg: TrainConfig) -> dict:
     ts, metrics = train_loop(
         model, optimizer, train_loader, cfg.epochs, seed_key(cfg.seed),
         writer=writer, log_every=cfg.log_every, step_fn=step, state=ts,
+        accum_steps=cfg.accum_steps,
     )
 
     eval_step = mp.make_eval_step()

@@ -123,8 +123,10 @@ def run(args) -> dict:
     if args.steps < 1:
         raise ValueError("--steps must be >= 1")
     distributed_init()
-    # Same-program guard (SURVEY.md §5.2): all ranks must agree on argv.
-    assert_same_program(repr(sorted(vars(args).items())), "task5 args")
+    # Same-program guard (SURVEY.md §5.2): all ranks must agree on argv
+    # (minus host-local paths, which may be rank-templated).
+    rank_invariant = {k: v for k, v in vars(args).items() if k != "log_dir"}
+    assert_same_program(repr(sorted(rank_invariant.items())), "task5 args")
     devices = jax.devices()
     if args.n_devices and args.parallel != "single":
         devices = devices[: args.n_devices]

@@ -141,6 +141,34 @@ def test_cp_training_trajectory_matches_single_device(mesh):
     assert losses[-1] < losses[0]
 
 
+def test_cp_composes_with_dp_matches_single_device():
+    """2-D {"data": 2, "seq": 4} mesh: batch sharded over data, time over
+    seq — still the same optimization as one device on the global batch."""
+    mesh2d = make_mesh(MeshConfig({"data": 2, "seq": 4}), jax.devices())
+    rng = np.random.default_rng(7)
+    tokens = jnp.asarray(rng.integers(0, 50, size=(4, T + 1)).astype(np.int32))
+    x, y = tokens[:, :-1], tokens[:, 1:]
+    base = dict(vocab_size=50, embed_dim=32, num_heads=4, num_layers=2, max_len=T)
+    opt = make_optimizer("sgd", 0.1)
+
+    cp_model = TransformerLM(**base, impl="ring", seq_sharded=True)
+    cp = ContextParallel(cp_model, opt, mesh2d, batch_axis="data")
+    ts = cp.create_state(seed_key(8))
+    step = cp.make_train_step()
+
+    ref_model = TransformerLM(**base)
+    ref_params = jax.device_get(ts.params)
+    ref_opt = opt.init(ref_params)
+    ref_loss = lambda p: softmax_cross_entropy(ref_model(p, x), y)
+
+    for _ in range(3):
+        ts, m = step(ts, x, y)
+        g = jax.grad(ref_loss)(ref_params)
+        ref_params, ref_opt = opt.update(g, ref_opt, ref_params)
+    for a, b in zip(jax.tree.leaves(ts.params), jax.tree.leaves(ref_params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-5)
+
+
 def test_ulysses_head_divisibility_check(mesh):
     q = jnp.ones((B, T // WORLD, 3, D))  # 3 heads, world 4
 

@@ -1,0 +1,70 @@
+"""Torch-weight interop tests: a reference-architecture torch model's
+state_dict loads into the tpudml model and produces matching logits on the
+same inputs (the migration guarantee for reference users)."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as tnn  # noqa: E402
+
+import jax.numpy as jnp  # noqa: E402
+
+from tpudml.interop import lenet_params_from_torch, mlp_params_from_torch  # noqa: E402
+from tpudml.models import ForwardMLP, LeNet  # noqa: E402
+
+
+class TorchNet(tnn.Module):
+    """The reference's Net (codes/task1/pytorch/model.py:16-35)."""
+
+    def __init__(self):
+        super().__init__()
+        self.conv1 = tnn.Conv2d(1, 6, 5, padding=2)
+        self.conv2 = tnn.Conv2d(6, 16, 5)
+        self.pool = tnn.MaxPool2d(2, 2)
+        self.fc1 = tnn.Linear(400, 120)
+        self.fc2 = tnn.Linear(120, 10)
+
+    def forward(self, x):
+        x = self.pool(torch.relu(self.conv1(x)))
+        x = self.pool(torch.relu(self.conv2(x)))
+        x = x.flatten(1)
+        return self.fc2(torch.relu(self.fc1(x)))
+
+
+def test_lenet_logits_match_torch():
+    tm = TorchNet().eval()
+    x = np.random.default_rng(0).normal(size=(4, 1, 28, 28)).astype(np.float32)
+    with torch.no_grad():
+        want = tm(torch.from_numpy(x)).numpy()
+
+    params = lenet_params_from_torch(tm.state_dict())
+    model = LeNet()
+    got = model(params, jnp.asarray(x.transpose(0, 2, 3, 1)))  # NCHW → NHWC
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-5)
+
+
+def test_mlp_logits_match_torch():
+    hidden = (512, 256, 128, 64, 32)
+    layers = []
+    prev = 784
+    for h in hidden:
+        layers += [tnn.Linear(prev, h), tnn.ReLU()]
+        prev = h
+    layers.append(tnn.Linear(prev, 10))
+    tm = tnn.Sequential(*layers).eval()
+    x = np.random.default_rng(1).normal(size=(4, 784)).astype(np.float32)
+    with torch.no_grad():
+        want = tm(torch.from_numpy(x)).numpy()
+
+    params = mlp_params_from_torch(tm.state_dict())
+    model = ForwardMLP()
+    got = model(params, jnp.asarray(x.reshape(4, 28, 28, 1)))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-5)
+
+
+def test_wrong_architecture_rejected():
+    with pytest.raises(ValueError, match="expected 2 conv"):
+        lenet_params_from_torch({"w.weight": np.zeros((6, 1, 5, 5))})
+    with pytest.raises(ValueError, match="no linear"):
+        mlp_params_from_torch({"w.weight": np.zeros((6, 1, 5, 5))})

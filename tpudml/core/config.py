@@ -117,6 +117,7 @@ class TrainConfig:
     bottleneck_rank: int | None = None  # straggler-injection target rank
     bottleneck_delay_s: float = 0.1  # reference: model-mp.py:47
     measure_comm: bool = False  # split-step comm-time accounting mode
+    accum_steps: int = 1  # gradient-accumulation micro-batches per step
     log_dir: str = "./logs"
     profile: bool = False  # capture a jax.profiler trace into the run dir
     ckpt_dir: str | None = None  # enable checkpointing under this directory
@@ -130,10 +131,13 @@ class TrainConfig:
     def fingerprint(self) -> str:
         """Rank-invariant program identity for the cross-process
         same-program check (``assert_same_program``): every field except
-        the per-process ``dist`` block and rank-targeted fault injection."""
+        the per-process ``dist`` block, rank-targeted fault injection, and
+        host-local paths (log/ckpt dirs may legitimately be rank-templated
+        without changing the SPMD program)."""
         d = dataclasses.asdict(self)
-        d.pop("dist", None)
-        d.pop("bottleneck_rank", None)
+        for k in ("dist", "bottleneck_rank", "log_dir", "ckpt_dir"):
+            d.pop(k, None)
+        d["data"].pop("data_dir", None)
         return repr(dict(sorted(d.items())))
 
 

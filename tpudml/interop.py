@@ -1,0 +1,93 @@
+"""Torch-weight interop: load reference-trained checkpoints into tpudml.
+
+Migration bridge for users of the reference lab code: a ``state_dict``
+from the reference's ``Net`` (codes/task1/pytorch/model.py:16-35) or the
+MindSpore-track MLP drops into the matching tpudml model, producing
+bit-equal logits. Handles the layout changes the TPU-first design made:
+
+- conv kernels: torch OIHW → NHWC-conv HWIO;
+- linear kernels: torch [out, in] → [in, out];
+- the first dense layer after a conv stack additionally permutes its input
+  rows from torch's channel-major flatten (C,H,W) to this framework's
+  channel-last flatten (H,W,C).
+
+Accepts torch tensors or numpy arrays (torch itself is not required
+unless the values are tensors).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import numpy as np
+
+
+def _to_np(x) -> np.ndarray:
+    if hasattr(x, "detach"):  # torch tensor, without importing torch
+        x = x.detach().cpu().numpy()
+    return np.asarray(x, dtype=np.float32)
+
+
+def _pairs(state_dict: Mapping[str, Any]) -> list[tuple[np.ndarray, np.ndarray]]:
+    """(weight, bias) per layer, in the state_dict's insertion order."""
+    weights = [(k, _to_np(v)) for k, v in state_dict.items() if k.endswith(".weight")]
+    out = []
+    for name, w in weights:
+        bias_key = name[: -len(".weight")] + ".bias"
+        b = _to_np(state_dict[bias_key]) if bias_key in state_dict else None
+        out.append((w, b))
+    return out
+
+
+def _conv_kernel(w: np.ndarray) -> np.ndarray:
+    return np.transpose(w, (2, 3, 1, 0))  # OIHW → HWIO
+
+
+def _dense_kernel(w: np.ndarray, prev_conv_spatial=None) -> np.ndarray:
+    k = np.transpose(w, (1, 0))  # [out, in] → [in, out]
+    if prev_conv_spatial is not None:
+        c, h, ww = prev_conv_spatial
+        # Rows are ordered by torch's (C,H,W) flatten; reorder to (H,W,C).
+        k = k.reshape(c, h, ww, -1).transpose(1, 2, 0, 3).reshape(c * h * ww, -1)
+    return k
+
+
+def lenet_params_from_torch(
+    state_dict: Mapping[str, Any], conv_out_spatial: tuple[int, int, int] = (16, 5, 5)
+) -> dict:
+    """Params tree for ``tpudml.models.LeNet`` from a reference ``Net``
+    state_dict (two convs then two linears, classified by tensor rank —
+    robust to parameter names). ``conv_out_spatial`` is the (C, H, W) of
+    the final conv output that the first linear consumes."""
+    convs = []
+    denses = []
+    for w, b in _pairs(state_dict):
+        (convs if w.ndim == 4 else denses).append((w, b))
+    if len(convs) != 2 or len(denses) != 2:
+        raise ValueError(
+            f"expected 2 conv + 2 linear layers, got {len(convs)} conv / "
+            f"{len(denses)} linear"
+        )
+    params: dict = {}
+    for idx, (w, b) in zip((0, 3), convs):
+        params[f"layer{idx}"] = {"kernel": _conv_kernel(w), "bias": b}
+    params["layer7"] = {
+        "kernel": _dense_kernel(denses[0][0], conv_out_spatial),
+        "bias": denses[0][1],
+    }
+    params["layer9"] = {"kernel": _dense_kernel(denses[1][0]), "bias": denses[1][1]}
+    return params
+
+
+def mlp_params_from_torch(state_dict: Mapping[str, Any]) -> dict:
+    """Params tree for ``tpudml.models.ForwardMLP`` from a pure-linear
+    torch/MindSpore MLP state_dict (layer order = state_dict order)."""
+    denses = [(w, b) for w, b in _pairs(state_dict) if w.ndim == 2]
+    if not denses:
+        raise ValueError("no linear layers found in state_dict")
+    params = {}
+    # ForwardMLP layout: Flatten, then (Dense, Activation)*; Dense layers
+    # land at Sequential indices 1, 3, 5, ... and the head last.
+    for i, (w, b) in enumerate(denses):
+        params[f"layer{2 * i + 1}"] = {"kernel": _dense_kernel(w), "bias": b}
+    return params

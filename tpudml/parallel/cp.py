@@ -140,6 +140,12 @@ class ContextParallel:
     time axis of inputs/labels is sharded, and parameter gradients are
     pmean-ed over the axis (per-shard token-mean losses of equal-size
     shards average to the global token mean).
+
+    Composes with data parallelism on a 2-D mesh: pass
+    ``batch_axis="data"`` with a {"data": D, "seq": S} mesh and the batch
+    dim shards over ``data`` while the time dim shards over ``seq`` —
+    ring/Ulysses collectives stay within each data replica's seq subgroup,
+    and gradients average over both axes.
     """
 
     def __init__(
@@ -148,11 +154,17 @@ class ContextParallel:
         optimizer: Optimizer,
         mesh: Mesh,
         axis_name: str = "seq",
+        batch_axis: str | None = None,
     ):
         self.model = model
         self.optimizer = optimizer
         self.mesh = mesh
         self.axis_name = axis_name
+        if batch_axis is not None and batch_axis not in mesh.shape:
+            raise ValueError(
+                f"batch_axis {batch_axis!r} not in mesh axes {tuple(mesh.shape)}"
+            )
+        self.batch_axis = batch_axis
         self.world = mesh.shape[axis_name]
         self._sync_each_step = serialize_dispatch(mesh)
 
@@ -164,7 +176,8 @@ class ContextParallel:
         )
 
     def _batch_spec(self) -> P:
-        return P(None, self.axis_name)  # [B, T, ...] sharded along time
+        # [B, T, ...]: time sharded over seq; batch over data when composed.
+        return P(self.batch_axis, self.axis_name)
 
     def make_forward(self) -> Callable:
         fwd = shard_map_fn(
@@ -174,6 +187,12 @@ class ContextParallel:
             out_specs=self._batch_spec(),
         )
         return jax.jit(fwd)
+
+    def _mean_axes(self) -> tuple[str, ...]:
+        # One fused all-reduce over the combined (seq[, data]) group.
+        return (self.axis_name,) + (
+            (self.batch_axis,) if self.batch_axis is not None else ()
+        )
 
     def make_train_step(self) -> Callable:
         axis = self.axis_name
@@ -188,14 +207,15 @@ class ContextParallel:
             (loss, (model_state, logits)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(ts.params)
-            grads = pmean_tree(grads, axis)
+            axes = self._mean_axes()
+            grads = pmean_tree(grads, axes)
             # Shard-consistent model state (e.g. norm running stats), same
             # treatment as the DP engine: averaged so replicas stay equal.
-            model_state = pmean_tree(model_state, axis)
+            model_state = pmean_tree(model_state, axes)
             new_params, new_opt = self.optimizer.update(grads, ts.opt_state, ts.params)
             metrics = {
-                "loss": lax.pmean(loss, axis),
-                "accuracy": lax.pmean(accuracy(logits, labels), axis),
+                "loss": lax.pmean(loss, axes),
+                "accuracy": lax.pmean(accuracy(logits, labels), axes),
             }
             new_ts = TrainState(
                 params=new_params,

@@ -31,7 +31,6 @@ from tpudml.comm.collectives import broadcast_from, get_aggregator, pmean_tree
 from tpudml.comm.timing import CommStats
 from tpudml.core.dist import process_index
 from tpudml.nn.layers import Module
-from tpudml.nn.losses import accuracy
 from tpudml.optim import Optimizer
 from tpudml.parallel.sharding import (
     data_sharding,
@@ -39,7 +38,7 @@ from tpudml.parallel.sharding import (
     serialize_dispatch,
     shard_map_fn,
 )
-from tpudml.train import TrainState, make_loss_fn
+from tpudml.train import TrainState, accumulate_grads, make_loss_fn
 
 PyTree = Any
 
@@ -68,6 +67,7 @@ class DataParallel:
         bottleneck_rank: int | None = None,
         bottleneck_delay_s: float = 0.1,
         rng_root: jax.Array | None = None,
+        accum_steps: int = 1,
     ):
         self.model = model
         self.optimizer = optimizer
@@ -79,6 +79,7 @@ class DataParallel:
         self.bottleneck_rank = bottleneck_rank
         self.bottleneck_delay_s = bottleneck_delay_s
         self.rng_root = rng_root
+        self.accum_steps = accum_steps
         self.comm_stats = CommStats()
         self.world = mesh.shape[axis_name]
         self._loss_fn = make_loss_fn(model)
@@ -142,9 +143,10 @@ class DataParallel:
                 jax.random.fold_in(self.rng_root, ts.step),
                 jax.lax.axis_index(self.axis_name),
             )
-        (loss, (model_state, logits)), grads = jax.value_and_grad(
-            self._loss_fn, has_aux=True
-        )(ts.params, ts.model_state, images, labels, rng)
+        grads, model_state, local = accumulate_grads(
+            self._loss_fn, ts.params, ts.model_state, images, labels, rng,
+            self.accum_steps,
+        )
         grads = self.aggregator(grads, self.axis_name)
         # Cross-replica-consistent BN stats: average the running stats so
         # every replica holds the same model_state (the reference's DDP
@@ -153,8 +155,8 @@ class DataParallel:
         model_state = pmean_tree(model_state, self.axis_name)
         new_params, new_opt = self.optimizer.update(grads, ts.opt_state, ts.params)
         metrics = {
-            "loss": jax.lax.pmean(loss, self.axis_name),
-            "accuracy": jax.lax.pmean(accuracy(logits, labels), self.axis_name),
+            "loss": jax.lax.pmean(local["loss"], self.axis_name),
+            "accuracy": jax.lax.pmean(local["accuracy"], self.axis_name),
         }
         new_ts = TrainState(
             params=new_params,
@@ -204,15 +206,14 @@ class DataParallel:
                     jax.random.fold_in(self.rng_root, ts.step),
                     jax.lax.axis_index(axis),
                 )
-            (loss, (model_state, logits)), grads = jax.value_and_grad(
-                self._loss_fn, has_aux=True
-            )(ts.params, ts.model_state, images, labels, rng)
+            grads, model_state, local = accumulate_grads(
+                self._loss_fn, ts.params, ts.model_state, images, labels, rng,
+                self.accum_steps,
+            )
             # Stack per-replica values on a leading axis so the host gets
             # them un-aggregated (out_spec P(axis) ⇒ [world, ...]).
             stack = lambda t: jax.tree.map(lambda x: x[None], t)
-            return stack(grads), stack(model_state), stack(
-                {"loss": loss, "accuracy": accuracy(logits, labels)}
-            )
+            return stack(grads), stack(model_state), stack(local)
 
         grad_fn = jax.jit(
             shard_map_fn(
