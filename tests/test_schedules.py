@@ -1,0 +1,164 @@
+"""LR schedules, Scheduled optimizer wrapper, device prefetch, and
+ring-attention remat tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tpudml.core.config import MeshConfig
+from tpudml.core.dist import make_mesh
+from tpudml.core.prng import seed_key
+from tpudml.data import prefetch_to_device
+from tpudml.models import LeNet
+from tpudml.optim import (
+    Scheduled,
+    Sgd,
+    constant,
+    cosine_decay,
+    linear_warmup,
+    step_decay,
+    warmup_cosine,
+)
+
+
+def test_schedule_shapes():
+    s = cosine_decay(1.0, 100)
+    np.testing.assert_allclose(float(s(0)), 1.0, rtol=1e-6)
+    np.testing.assert_allclose(float(s(50)), 0.5, rtol=1e-6)
+    np.testing.assert_allclose(float(s(100)), 0.0, atol=1e-7)
+    np.testing.assert_allclose(float(s(1000)), 0.0, atol=1e-7)  # clamped
+
+    w = linear_warmup(2.0, 4)
+    np.testing.assert_allclose([float(w(i)) for i in range(5)],
+                               [0.5, 1.0, 1.5, 2.0, 2.0], rtol=1e-6)
+
+    wc = warmup_cosine(1.0, 10, 110)
+    assert float(wc(0)) < float(wc(9))
+    np.testing.assert_allclose(float(wc(10)), 1.0, rtol=1e-5)
+    np.testing.assert_allclose(float(wc(110)), 0.0, atol=1e-6)
+
+    sd = step_decay(1.0, 10, gamma=0.1)
+    np.testing.assert_allclose(float(sd(9)), 1.0, rtol=1e-6)
+    np.testing.assert_allclose(float(sd(10)), 0.1, rtol=1e-6)
+    np.testing.assert_allclose(float(sd(25)), 0.01, rtol=1e-5)
+
+
+def test_scheduled_matches_manual_lr_sequence():
+    """Scheduled(SGD, schedule) == running plain SGD with the per-step lr."""
+    sched = step_decay(0.1, 2, gamma=0.5)
+    opt = Scheduled(Sgd(momentum=0.9), sched)
+    params = {"w": jnp.arange(4.0)}
+    grads = {"w": jnp.ones(4)}
+    state = opt.init(params)
+
+    ref = {"w": jnp.arange(4.0)}
+    buf = {"w": jnp.zeros(4)}
+    for t in range(5):
+        params, state = opt.update(grads, state, params)
+        lr = float(sched(t))
+        buf = {"w": 0.9 * buf["w"] + grads["w"]}
+        ref = {"w": ref["w"] - lr * buf["w"]}
+    np.testing.assert_allclose(np.asarray(params["w"]), np.asarray(ref["w"]), rtol=1e-6)
+    assert int(state["t"]) == 5
+
+
+def test_scheduled_trains_jitted():
+    from tpudml.data.datasets import synthetic_classification
+    from tpudml.train import TrainState, make_train_step
+
+    model = LeNet()
+    opt = Scheduled(Sgd(momentum=0.9), warmup_cosine(0.05, 5, 30))
+    images, labels = synthetic_classification(32, (28, 28, 1), 10, seed=0)
+    step = make_train_step(model, opt)
+    ts = TrainState.create(model, opt, seed_key(0))
+    first = None
+    for _ in range(10):
+        ts, m = step(ts, images, labels)
+        first = first if first is not None else float(m["loss"])
+    assert float(m["loss"]) < first
+
+
+def test_prefetch_yields_all_on_device():
+    batches = [(np.full((2, 2), i, np.float32), np.array([i])) for i in range(5)]
+    out = list(prefetch_to_device(iter(batches), size=2))
+    assert len(out) == 5
+    for i, (x, y) in enumerate(out):
+        assert isinstance(x, jax.Array)
+        np.testing.assert_array_equal(np.asarray(x), batches[i][0])
+    with pytest.raises(ValueError, match=">= 1"):
+        next(prefetch_to_device(iter(batches), size=0))
+
+
+def test_prefetch_with_sharding():
+    mesh = make_mesh(MeshConfig({"data": 4}), jax.devices()[:4])
+    from jax.sharding import NamedSharding
+
+    sharding = NamedSharding(mesh, P("data"))
+    batches = [np.ones((8, 3), np.float32)]
+    (x,) = list(prefetch_to_device(iter(batches), sharding=sharding))
+    assert x.sharding == sharding
+
+
+def test_scheduled_rejects_lr_less_base():
+    class NoLr(Sgd.__mro__[1]):  # plain Optimizer subclass, not a dataclass
+        def update(self, grads, state, params):
+            return params, state
+
+    with pytest.raises(ValueError, match="'lr' field"):
+        Scheduled(NoLr(), constant(0.1))
+    # Zero-length schedules must not produce NaN lrs.
+    assert np.isfinite(float(cosine_decay(0.1, 0)(5)))
+    assert np.isfinite(float(step_decay(0.1, 0)(5)))
+
+
+def test_remat_reachable_from_model():
+    """TransformerLM(remat=True) must plumb down to ring attention and
+    still match the non-remat model exactly."""
+    from tpudml.core.config import MeshConfig as MC
+    from tpudml.models import TransformerLM
+    from tpudml.parallel.cp import ContextParallel
+    from tpudml.optim import make_optimizer
+
+    mesh = make_mesh(MC({"seq": 4}), jax.devices()[:4])
+    tokens = jnp.asarray(
+        np.random.default_rng(2).integers(0, 32, size=(2, 16)).astype(np.int32)
+    )
+    base = dict(vocab_size=32, embed_dim=16, num_heads=4, num_layers=1,
+                max_len=16, impl="ring", seq_sharded=True)
+    params, _ = TransformerLM(**base).init(seed_key(0))
+    opt = make_optimizer("sgd", 0.1)
+    plain = ContextParallel(TransformerLM(**base), opt, mesh).make_forward()
+    remat = ContextParallel(TransformerLM(**base, remat=True), opt, mesh).make_forward()
+    np.testing.assert_allclose(
+        np.asarray(remat(params, tokens)), np.asarray(plain(params, tokens)),
+        rtol=1e-5,
+    )
+
+
+def test_ring_attention_remat_matches():
+    from tpudml.nn.attention import dot_product_attention
+    from tpudml.parallel.cp import ring_attention
+    from tpudml.parallel.sharding import shard_map_fn
+
+    mesh = make_mesh(MeshConfig({"seq": 4}), jax.devices()[:4])
+    rng = np.random.default_rng(0)
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(2, 32, 4, 8)).astype(np.float32))
+        for _ in range(3)
+    )
+    spec = P(None, "seq")
+
+    def loss(q, k, v, remat):
+        fn = shard_map_fn(
+            lambda q, k, v: ring_attention(q, k, v, "seq", causal=True, remat=remat),
+            mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        )
+        return jnp.sum(fn(q, k, v) ** 2)
+
+    g_plain = jax.grad(lambda q: loss(q, k, v, False))(q)
+    g_remat = jax.grad(lambda q: loss(q, k, v, True))(q)
+    np.testing.assert_allclose(np.asarray(g_plain), np.asarray(g_remat), rtol=1e-5)
+    want = jnp.sum(dot_product_attention(q, k, v, causal=True) ** 2)
+    np.testing.assert_allclose(float(loss(q, k, v, True)), float(want), rtol=1e-5)

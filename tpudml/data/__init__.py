@@ -1,6 +1,7 @@
 from tpudml.data.datasets import ArrayDataset, load_cifar10, load_dataset, load_mnist
 from tpudml.data.idx import read_idx, write_idx
 from tpudml.data.loader import DataLoader, ShardedDataLoader
+from tpudml.data.prefetch import prefetch_to_device
 from tpudml.data.sampler import (
     RandomPartitionSampler,
     RandomSamplingSampler,
@@ -18,6 +19,7 @@ __all__ = [
     "write_idx",
     "DataLoader",
     "ShardedDataLoader",
+    "prefetch_to_device",
     "Sampler",
     "SequentialSampler",
     "RandomPartitionSampler",

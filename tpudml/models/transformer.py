@@ -37,6 +37,7 @@ class TransformerBlock(Module):
     causal: bool = True
     impl: str = "full"
     axis_name: str = "seq"
+    remat: bool = False
     mlp_ratio: int = 4
     dtype: Any = jnp.float32
 
@@ -50,6 +51,7 @@ class TransformerBlock(Module):
                 causal=self.causal,
                 impl=self.impl,
                 axis_name=self.axis_name,
+                remat=self.remat,
                 dtype=self.dtype,
             ),
             "ln2": LayerNorm(d, dtype=self.dtype),
@@ -155,6 +157,7 @@ class TransformerLM(Module):
     impl: str = "full"
     axis_name: str = "seq"
     seq_sharded: bool = False
+    remat: bool = False
     dtype: Any = jnp.float32
 
     def _block(self) -> TransformerBlock:
@@ -164,6 +167,7 @@ class TransformerLM(Module):
             causal=True,
             impl=self.impl,
             axis_name=self.axis_name,
+            remat=self.remat,
             dtype=self.dtype,
         )
 

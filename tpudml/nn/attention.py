@@ -70,6 +70,7 @@ class MultiHeadAttention(Module):
     causal: bool = False
     impl: str = "full"
     axis_name: str = "seq"
+    remat: bool = False  # ring impl: rematerialize ticks in backward
     dtype: Any = jnp.float32
 
     def __post_init__(self):
@@ -111,7 +112,9 @@ class MultiHeadAttention(Module):
         elif self.impl == "ring":
             from tpudml.parallel.cp import ring_attention
 
-            o = ring_attention(q, k, v, self.axis_name, causal=self.causal)
+            o = ring_attention(
+                q, k, v, self.axis_name, causal=self.causal, remat=self.remat
+            )
         elif self.impl == "ulysses":
             from tpudml.parallel.cp import ulysses_attention
 

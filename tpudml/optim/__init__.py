@@ -6,6 +6,14 @@ from tpudml.optim.optimizers import (
     Sgd,
     make_optimizer,
 )
+from tpudml.optim.schedules import (
+    Scheduled,
+    constant,
+    cosine_decay,
+    linear_warmup,
+    step_decay,
+    warmup_cosine,
+)
 
 __all__ = [
     "Optimizer",
@@ -14,4 +22,10 @@ __all__ = [
     "Adam",
     "ReferenceAdam",
     "make_optimizer",
+    "Scheduled",
+    "constant",
+    "cosine_decay",
+    "linear_warmup",
+    "step_decay",
+    "warmup_cosine",
 ]

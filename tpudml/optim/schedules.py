@@ -1,0 +1,114 @@
+"""Learning-rate schedules + a schedule-driving optimizer wrapper.
+
+The reference trains with constants (lrs hardcoded per task, SURVEY.md
+§5.6). Schedules are pure ``step -> lr`` functions; ``Scheduled`` wraps
+any tpudml optimizer, tracking the step count in its own state and
+re-deriving the wrapped optimizer's lr each update — everything stays a
+pure pytree transform, jit/shard-compatible, and the optimizer-state
+sharding contract (``init_spec``) passes straight through.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from tpudml.optim.optimizers import Optimizer
+
+
+def constant(lr: float) -> Callable:
+    return lambda step: jnp.asarray(lr, jnp.float32)
+
+
+def cosine_decay(lr: float, decay_steps: int, alpha: float = 0.0) -> Callable:
+    """lr · (α + (1-α)·(1+cos(π·t/T))/2), clamped after T."""
+
+    def schedule(step):
+        frac = jnp.clip(step / max(decay_steps, 1), 0.0, 1.0)
+        cos = 0.5 * (1.0 + jnp.cos(jnp.pi * frac))
+        return lr * (alpha + (1.0 - alpha) * cos)
+
+    return schedule
+
+
+def linear_warmup(lr: float, warmup_steps: int) -> Callable:
+    """0 → lr over ``warmup_steps``, constant after."""
+
+    def schedule(step):
+        return lr * jnp.clip((step + 1) / max(warmup_steps, 1), 0.0, 1.0)
+
+    return schedule
+
+
+def warmup_cosine(
+    lr: float, warmup_steps: int, total_steps: int, alpha: float = 0.0
+) -> Callable:
+    """Linear warmup into a cosine decay — the standard transformer recipe."""
+    decay = cosine_decay(lr, max(total_steps - warmup_steps, 1), alpha)
+
+    def schedule(step):
+        return jnp.where(
+            step < warmup_steps,
+            lr * (step + 1) / max(warmup_steps, 1),
+            decay(step - warmup_steps),
+        )
+
+    return schedule
+
+
+def step_decay(lr: float, step_size: int, gamma: float = 0.1) -> Callable:
+    """lr · γ^floor(t/step_size) (torch StepLR semantics)."""
+
+    def schedule(step):
+        return lr * gamma ** jnp.floor(step / max(step_size, 1))
+
+    return schedule
+
+
+@dataclass(frozen=True)
+class Scheduled(Optimizer):
+    """Drive ``base``'s learning rate from ``schedule(step)``.
+
+    Usage::
+
+        opt = Scheduled(Sgd(momentum=0.9), warmup_cosine(0.1, 100, 1000))
+    """
+
+    base: Optimizer
+    schedule: Callable
+
+    def __post_init__(self):
+        # update() swaps the lr via dataclasses.replace — fail at
+        # construction, not mid-jit-trace, if the base can't support that.
+        if not dataclasses.is_dataclass(self.base) or not any(
+            f.name == "lr" for f in dataclasses.fields(self.base)
+        ):
+            raise ValueError(
+                f"Scheduled needs a dataclass optimizer with an 'lr' field; "
+                f"got {type(self.base).__name__}"
+            )
+
+    def init(self, params):
+        return {
+            "inner": self.base.init(params),
+            "t": jnp.zeros((), jnp.int32),
+        }
+
+    def init_spec(self, param_specs):
+        return {"inner": self.base.init_spec(param_specs), "t": P()}
+
+    def update(self, grads, state, params):
+        lr = self.schedule(state["t"])
+        # Re-instantiate the wrapped optimizer with the scheduled lr (a
+        # traced scalar); its update math is unchanged.
+        inner_opt = dataclasses.replace(self.base, lr=lr)
+        new_params, inner_state = inner_opt.update(grads, state["inner"], params)
+        return new_params, {"inner": inner_state, "t": state["t"] + 1}
+
+    def current_lr(self, state) -> jax.Array:
+        return self.schedule(state["t"])

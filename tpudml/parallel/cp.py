@@ -50,11 +50,15 @@ def ring_attention(
     axis_name: str,
     *,
     causal: bool = False,
+    remat: bool = False,
 ) -> jax.Array:
     """Ring self-attention over a sharded sequence axis.
 
     Args are the local shards [B, T/W, H, D]. Returns the local output
     shard, bitwise-independent of W up to float accumulation order.
+    ``remat=True`` rematerializes each ring tick in the backward pass
+    (scores/probs recomputed instead of stored — W× less attention
+    residual memory, the flash-attention trade, for very long contexts).
     """
     world = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
@@ -103,6 +107,8 @@ def ring_attention(
         acc = fold(acc, kb, vb, (idx - step) % world)
         return (acc, kb, vb), None
 
+    if remat:
+        tick = jax.checkpoint(tick)
     ((o, _, l), _, _), _ = lax.scan(tick, (acc0, k, v), jnp.arange(1, world))
     out = o / l.transpose(0, 2, 1)[..., None]
     return out.astype(q.dtype)
