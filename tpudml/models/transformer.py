@@ -30,7 +30,12 @@ from tpudml.nn.layers import Dense, LayerNorm, Module
 
 @dataclass(frozen=True)
 class TransformerBlock(Module):
-    """Pre-LN decoder block: x + MHA(LN(x)); x + MLP(LN(x))."""
+    """Pre-LN decoder block: x + MHA(LN(x)); x + FFN(LN(x)).
+
+    ``moe_experts > 0`` swaps the dense FFN for a Switch-style
+    mixture-of-experts layer (``tpudml.nn.moe``); set ``moe_axis`` to run
+    the experts sharded under the ExpertParallel engine.
+    """
 
     embed_dim: int
     num_heads: int
@@ -39,11 +44,14 @@ class TransformerBlock(Module):
     axis_name: str = "seq"
     remat: bool = False
     mlp_ratio: int = 4
+    moe_experts: int = 0
+    moe_axis: str | None = None
+    moe_capacity_factor: float = 2.0
     dtype: Any = jnp.float32
 
     def _parts(self):
         d = self.embed_dim
-        return {
+        parts = {
             "ln1": LayerNorm(d, dtype=self.dtype),
             "attn": MultiHeadAttention(
                 d,
@@ -55,24 +63,50 @@ class TransformerBlock(Module):
                 dtype=self.dtype,
             ),
             "ln2": LayerNorm(d, dtype=self.dtype),
-            "fc1": Dense(d, self.mlp_ratio * d, dtype=self.dtype),
-            "fc2": Dense(self.mlp_ratio * d, d, dtype=self.dtype),
         }
+        if self.moe_experts:
+            from tpudml.nn.moe import MoELayer
+
+            parts["moe"] = MoELayer(
+                d,
+                self.moe_experts,
+                mlp_ratio=self.mlp_ratio,
+                capacity_factor=self.moe_capacity_factor,
+                axis_name=self.moe_axis,
+                dtype=self.dtype,
+            )
+        else:
+            parts["fc1"] = Dense(d, self.mlp_ratio * d, dtype=self.dtype)
+            parts["fc2"] = Dense(self.mlp_ratio * d, d, dtype=self.dtype)
+        return parts
 
     def init(self, key):
         parts = self._parts()
         keys = jax.random.split(key, len(parts))
-        return {n: m.init(k)[0] for (n, m), k in zip(parts.items(), keys)}, {}
+        params, states = {}, {}
+        for (n, m), k in zip(parts.items(), keys):
+            p, s = m.init(k)
+            params[n] = p
+            if s:
+                states[n] = s  # e.g. the MoE aux-loss slot
+        return params, states
 
     def apply(self, params, state, x, *, train=False, rng=None):
         parts = self._parts()
+        new_state = {}
         h = parts["ln1"](params["ln1"], x)
         h = parts["attn"](params["attn"], h)
         x = x + h
         h = parts["ln2"](params["ln2"], x)
-        h = jax.nn.gelu(parts["fc1"](params["fc1"], h))
-        h = parts["fc2"](params["fc2"], h)
-        return x + h, state
+        if self.moe_experts:
+            h, moe_state = parts["moe"].apply(
+                params["moe"], state.get("moe", {}), h, train=train
+            )
+            new_state["moe"] = moe_state
+        else:
+            h = jax.nn.gelu(parts["fc1"](params["fc1"], h))
+            h = parts["fc2"](params["fc2"], h)
+        return x + h, new_state
 
 
 @dataclass(frozen=True)
@@ -158,6 +192,9 @@ class TransformerLM(Module):
     axis_name: str = "seq"
     seq_sharded: bool = False
     remat: bool = False
+    moe_experts: int = 0
+    moe_axis: str | None = None
+    moe_capacity_factor: float = 2.0
     dtype: Any = jnp.float32
 
     def _block(self) -> TransformerBlock:
@@ -168,6 +205,9 @@ class TransformerLM(Module):
             impl=self.impl,
             axis_name=self.axis_name,
             remat=self.remat,
+            moe_experts=self.moe_experts,
+            moe_axis=self.moe_axis,
+            moe_capacity_factor=self.moe_capacity_factor,
             dtype=self.dtype,
         )
 
@@ -194,16 +234,26 @@ class TransformerLM(Module):
         params = dict(self._embed().init(ke)[0])
         params.update(self._head().init(kh)[0])
         block = self._block()
+        states = {}
         for i, k in enumerate(jax.random.split(kb, self.num_layers)):
-            params[f"block{i}"] = block.init(k)[0]
-        return params, {}
+            p, s = block.init(k)
+            params[f"block{i}"] = p
+            if s:
+                states[f"block{i}"] = s  # MoE aux-loss slots
+        return params, states
 
     def apply(self, params, state, tokens, *, train=False, rng=None):
         h = self._embed()(
             {k: params[k] for k in ("tok_embed", "pos_embed")}, tokens
         )
         block = self._block()
+        new_state = {}
         for i in range(self.num_layers):
-            h, _ = block.apply(params[f"block{i}"], {}, h, train=train, rng=rng)
+            h, s = block.apply(
+                params[f"block{i}"], state.get(f"block{i}", {}), h,
+                train=train, rng=rng,
+            )
+            if s:
+                new_state[f"block{i}"] = s
         logits = self._head()({k: params[k] for k in ("ln_f", "head")}, h)
-        return logits, state
+        return logits, new_state

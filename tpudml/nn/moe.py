@@ -62,7 +62,10 @@ class MoELayer(Module):
                 "b2": _uniform_fan_in(kb2, (e, d), h, self.dtype),
             },
         }
-        return params, {}
+        # aux_loss lives in state from init so the TrainState pytree
+        # structure is stable across steps; make_loss_fn(aux_loss_weight=α)
+        # folds it into the objective (gradients flow to the router).
+        return params, {"aux_loss": jnp.zeros((), jnp.float32)}
 
     def _capacity(self, n_tokens: int) -> int:
         return max(1, int(n_tokens * self.capacity_factor / self.num_experts + 0.5))
@@ -109,7 +112,11 @@ class MoELayer(Module):
             )
         combine = disp * gate[:, None, None]
         y = jnp.einsum("gec,ecd->gd", combine, expert_out)
-        return y.reshape(shape), state
+        # Switch aux loss over this shard's tokens: E · Σ_e frac_e · p̄_e
+        # (=1 when routing is uniform); differentiable through probs.
+        frac = jnp.mean(onehot, axis=0)
+        aux = self.num_experts * jnp.sum(frac * jnp.mean(probs, axis=0))
+        return y.reshape(shape), {"aux_loss": aux}
 
 
 def load_balancing_loss(params: dict, x: jax.Array, num_experts: int) -> jax.Array:

@@ -66,13 +66,16 @@ class ExpertParallel:
         optimizer: Optimizer,
         mesh: Mesh,
         axis_name: str = "expert",
+        aux_loss_weight: float = 1e-2,
     ):
         self.model = model
         self.optimizer = optimizer
         self.mesh = mesh
         self.axis_name = axis_name
         self.world = mesh.shape[axis_name]
-        self._loss_fn = make_loss_fn(model)
+        # Switch load-balancing pressure on by default for MoE training
+        # (the canonical α≈0.01); pass 0.0 to disable.
+        self._loss_fn = make_loss_fn(model, aux_loss_weight=aux_loss_weight)
         self._sync_each_step = serialize_dispatch(mesh)
         # Specs derive from the model structure alone (eval_shape — no
         # compute), so step functions can be built before/without

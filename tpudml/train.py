@@ -45,17 +45,36 @@ class TrainState:
         )
 
 
+def collect_aux_losses(state: Any) -> jax.Array:
+    """Sum of every ``aux_loss`` leaf in a model-state tree (e.g. the
+    Switch load-balancing terms MoE layers record, one per layer)."""
+    total = jnp.zeros((), jnp.float32)
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        last = path[-1] if path else None
+        if getattr(last, "key", None) == "aux_loss":
+            total = total + leaf
+    return total
+
+
 def make_loss_fn(
-    model: Module, loss: Callable = softmax_cross_entropy
+    model: Module,
+    loss: Callable = softmax_cross_entropy,
+    aux_loss_weight: float = 0.0,
 ) -> Callable:
     """(params, model_state, images, labels[, rng]) -> (loss, (new_model_state,
-    logits))."""
+    logits)). ``aux_loss_weight`` adds α·Σ(aux_loss leaves of the new model
+    state) to the objective — the Switch router load-balancing pressure
+    (``tpudml.nn.moe``); gradients flow to the router through the recorded
+    aux terms."""
 
     def loss_fn(params, model_state, images, labels, rng=None):
         logits, new_state = model.apply(
             params, model_state, images, train=True, rng=rng
         )
-        return loss(logits, labels), (new_state, logits)
+        total = loss(logits, labels)
+        if aux_loss_weight:
+            total = total + aux_loss_weight * collect_aux_losses(new_state)
+        return total, (new_state, logits)
 
     return loss_fn
 
