@@ -162,7 +162,7 @@ def test_aux_loss_threads_through_state_and_objective():
     import jax.numpy as jnp
 
     from tpudml.models import TransformerLM
-    from tpudml.train import TrainState, make_loss_fn
+    from tpudml.train import make_loss_fn
 
     lm = TransformerLM(
         vocab_size=16, embed_dim=16, num_heads=2, num_layers=2, max_len=8,

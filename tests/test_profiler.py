@@ -5,8 +5,6 @@ import os
 
 import jax.numpy as jnp
 import numpy as np
-import pytest
-
 from tpudml.metrics.profiler import SpanTimer, annotate, trace
 
 

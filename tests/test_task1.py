@@ -9,7 +9,7 @@ from tpudml.data import DataLoader, load_dataset
 from tpudml.data.sampler import RandomPartitionSampler
 from tpudml.models import LeNet
 from tpudml.optim import make_optimizer
-from tpudml.train import TrainState, evaluate, make_train_step, train_loop
+from tpudml.train import TrainState, make_train_step
 
 
 def test_task1_end_to_end(tmp_path):
