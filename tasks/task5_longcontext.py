@@ -25,6 +25,7 @@ Run: ``python -m tasks.task5_longcontext --parallel cp --seq_len 512``
 from __future__ import annotations
 
 import argparse
+import math
 import time
 
 import jax
@@ -156,13 +157,21 @@ def run(args) -> dict:
     elapsed = time.time() - t0 if t0 else float("nan")
     tokens = (args.steps - steady_from) * args.batch_size * args.seq_len
     tok_per_s = tokens / elapsed if elapsed and elapsed > 0 else float("nan")
+    ppl = math.exp(min(loss, 20.0))
     print(
         f"[{args.parallel}/{args.attn or 'default'}] {len(devices)} device(s), "
-        f"T={args.seq_len}: {tok_per_s:,.0f} tokens/sec, final loss {loss:.4f}"
+        f"T={args.seq_len}: {tok_per_s:,.0f} tokens/sec, final loss {loss:.4f} "
+        f"(ppl {ppl:.2f})"
     )
     writer.add_scalar("Tokens Per Sec", tok_per_s, args.steps)
+    writer.add_scalar("Perplexity", ppl, args.steps)
     writer.close()
-    return {"tokens_per_sec": tok_per_s, "final_loss": loss, "devices": len(devices)}
+    return {
+        "tokens_per_sec": tok_per_s,
+        "final_loss": loss,
+        "perplexity": ppl,
+        "devices": len(devices),
+    }
 
 
 def main(argv=None):

@@ -124,6 +124,37 @@ def test_hook_keys_by_global_step_across_resume(tmp_path, state):
     assert sorted(p.name for p in tmp_path.iterdir()) == ["step_102", "step_104"]
 
 
+def test_async_write_roundtrip(tmp_path, state):
+    """Async saves land complete checkpoints; restore/wait join the
+    in-flight write and errors surface at the next call."""
+    _, _, ts = state
+    mgr = CheckpointManager(tmp_path, keep=2, async_write=True)
+    for s in (1, 2, 3):
+        mgr.save(ts, s)
+    assert mgr.latest_step() == 3  # implies wait() joined the writer
+    assert sorted(p.name for p in tmp_path.iterdir()) == ["step_2", "step_3"]
+    restored = mgr.restore_latest(
+        TrainState.create(LeNet(), make_optimizer("adam", 1e-3), seed_key(4))
+    )
+    for a, b in zip(jax.tree.leaves(ts), jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_async_write_error_surfaces(tmp_path, state):
+    _, _, ts = state
+    mgr = CheckpointManager(tmp_path / "f", keep=2, async_write=True)
+    mgr.save(ts, 1)
+    mgr.wait()
+    # Sabotage the directory so the next background write fails.
+    import shutil
+
+    shutil.rmtree(tmp_path / "f")
+    (tmp_path / "f").write_text("not a directory")
+    mgr.save(ts, 2)
+    with pytest.raises(BaseException):
+        mgr.wait()
+
+
 def test_restore_latest_passthrough_when_empty(tmp_path, state):
     _, _, ts = state
     mgr = CheckpointManager(tmp_path / "none")

@@ -169,6 +169,24 @@ def test_cp_composes_with_dp_matches_single_device():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-5)
 
 
+def test_cp_evaluate_matches_single_device(mesh):
+    rng = np.random.default_rng(9)
+    tokens = jnp.asarray(rng.integers(0, 50, size=(B, T + 1)).astype(np.int32))
+    x, y = tokens[:, :-1], tokens[:, 1:]
+    base = dict(vocab_size=50, embed_dim=32, num_heads=4, num_layers=1, max_len=T)
+    cp = ContextParallel(
+        TransformerLM(**base, impl="ring", seq_sharded=True),
+        make_optimizer("sgd", 0.1),
+        mesh,
+    )
+    ts = cp.create_state(seed_key(10))
+    acc = cp.evaluate(ts, [(x, y)])
+    ref_model = TransformerLM(**base)
+    logits = ref_model(jax.device_get(ts.params), x)
+    want = float(np.mean(np.argmax(np.asarray(logits), -1) == np.asarray(y)))
+    np.testing.assert_allclose(acc, want, atol=1e-6)
+
+
 def test_ulysses_head_divisibility_check(mesh):
     q = jnp.ones((B, T // WORLD, 3, D))  # 3 heads, world 4
 

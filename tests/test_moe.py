@@ -140,6 +140,9 @@ def test_moe_transformer_trains_under_ep():
         ts, m = step(ts, seqs[:, :-1], seqs[:, 1:])
         first = first if first is not None else float(m["loss"])
     assert float(m["loss"]) < first * 0.5
+    # Sharded eval agrees with train-time accuracy direction.
+    acc = ep.evaluate(ts, [(seqs[:, :-1], seqs[:, 1:])])
+    assert 0.0 <= acc <= 1.0 and acc > 0.2
 
 
 def test_moe_transformer_dense_matches_sharded_init():

@@ -7,6 +7,7 @@ import os
 import re
 import shutil
 import tempfile
+import threading
 from typing import Any, Callable
 
 import jax
@@ -169,15 +170,67 @@ class CheckpointManager:
         mgr = CheckpointManager(run_dir, keep=3)
         mgr.save(train_state, step)
         ts = mgr.restore_latest(train_state)   # no-op passthrough if empty
+
+    ``async_write=True`` moves the npz serialization + atomic rename to a
+    background thread: ``save`` still synchronously snapshots the leaves to
+    host memory (so the training step can donate/overwrite its buffers
+    immediately) but returns before the file I/O completes. One write is in
+    flight at a time — a new save (or ``wait()``/``restore_latest``) joins
+    the previous one first, so on-disk state is always a complete
+    checkpoint. Not supported multi-process (the cross-host barrier must
+    stay synchronous).
     """
 
-    def __init__(self, directory: str | os.PathLike, keep: int = 3):
+    def __init__(
+        self,
+        directory: str | os.PathLike,
+        keep: int = 3,
+        async_write: bool = False,
+    ):
         self.directory = os.fspath(directory)
         self.keep = keep
+        if async_write and process_count() > 1:
+            raise ValueError(
+                "async_write is single-process only (the multi-host save "
+                "barrier must remain synchronous)"
+            )
+        self.async_write = async_write
+        self._pending: threading.Thread | None = None
+        self._pending_error: list[BaseException] = []
+
+    def wait(self) -> None:
+        """Block until an in-flight async save (if any) has hit disk;
+        re-raise its error, if it failed, at this call site."""
+        if self._pending is not None:
+            self._pending.join()
+            self._pending = None
+        if self._pending_error:
+            raise self._pending_error.pop()
 
     def save(self, tree: PyTree, step: int, metadata: dict | None = None) -> str:
-        path = save_checkpoint(self.directory, tree, step, metadata=metadata)
-        self._prune()
+        if not self.async_write:
+            path = save_checkpoint(self.directory, tree, step, metadata=metadata)
+            self._prune()
+            return path
+        self.wait()  # one write in flight; surface any prior failure
+        # Synchronous part: host snapshot (cheap vs the file write) so the
+        # caller may mutate/donate device buffers right away.
+        leaves = [_fetch_leaf(x) for x in jax.tree.leaves(tree)]
+        treedef = jax.tree.structure(tree)
+        snapshot = jax.tree.unflatten(treedef, leaves)
+        path = os.path.join(self.directory, f"step_{step}")
+
+        def write():
+            try:
+                save_checkpoint(self.directory, snapshot, step, metadata=metadata)
+                self._prune()
+            except BaseException as e:  # surfaced on next wait()/save()
+                self._pending_error.append(e)
+
+        # Non-daemon: the interpreter joins it at normal exit, so a final
+        # save can't be silently truncated by process shutdown.
+        self._pending = threading.Thread(target=write, daemon=False)
+        self._pending.start()
         return path
 
     def _prune(self) -> None:
@@ -192,12 +245,14 @@ class CheckpointManager:
             shutil.rmtree(os.path.join(self.directory, f"step_{s}"), True)
 
     def latest_step(self) -> int | None:
+        self.wait()
         path = latest_checkpoint(self.directory)
         if path is None:
             return None
         return int(_STEP_DIR.match(os.path.basename(path)).group(1))
 
     def restore_latest(self, target: PyTree) -> PyTree:
+        self.wait()
         path = latest_checkpoint(self.directory)
         if path is None:
             return target

@@ -38,7 +38,7 @@ from tpudml.nn.layers import Module
 from tpudml.nn.losses import accuracy, softmax_cross_entropy
 from tpudml.optim import Optimizer
 from tpudml.parallel.sharding import serialize_dispatch, shard_map_fn
-from tpudml.train import TrainState
+from tpudml.train import TrainState, evaluate_counts
 
 PyTree = Any
 
@@ -173,6 +173,7 @@ class ContextParallel:
         self.batch_axis = batch_axis
         self.world = mesh.shape[axis_name]
         self._sync_each_step = serialize_dispatch(mesh)
+        self._eval_step = None
 
     def create_state(self, key: jax.Array) -> TrainState:
         from tpudml.parallel.sharding import replicate
@@ -199,6 +200,38 @@ class ContextParallel:
         return (self.axis_name,) + (
             (self.batch_axis,) if self.batch_axis is not None else ()
         )
+
+    def make_eval_step(self) -> Callable:
+        """Jitted sharded eval: (params, model_state, tokens, labels) →
+        (correct_predictions, token_count), summed over every shard.
+        Cached on the engine, so repeated evaluate() calls reuse one
+        compiled program."""
+        if self._eval_step is None:
+            spec = self._batch_spec()
+
+            def spmd(params, model_state, tokens, labels):
+                logits, _ = self.model.apply(
+                    params, model_state, tokens, train=False
+                )
+                correct = jnp.sum(
+                    (jnp.argmax(logits, -1) == labels).astype(jnp.int32)
+                )
+                axes = self._mean_axes()
+                return lax.psum(correct, axes), lax.psum(labels.size, axes)
+
+            self._eval_step = jax.jit(
+                shard_map_fn(
+                    spmd,
+                    self.mesh,
+                    in_specs=(P(), P(), spec, spec),
+                    out_specs=(P(), P()),
+                )
+            )
+        return self._eval_step
+
+    def evaluate(self, ts: TrainState, loader) -> float:
+        """Token-level top-1 accuracy over a loader of (tokens, labels)."""
+        return evaluate_counts(self.make_eval_step(), ts, loader)
 
     def make_train_step(self) -> Callable:
         axis = self.axis_name

@@ -30,7 +30,7 @@ from tpudml.nn.layers import Module
 from tpudml.nn.losses import accuracy
 from tpudml.optim import Optimizer
 from tpudml.parallel.sharding import serialize_dispatch, shard_map_fn
-from tpudml.train import TrainState, make_loss_fn
+from tpudml.train import TrainState, evaluate_counts, make_loss_fn
 
 PyTree = Any
 
@@ -77,6 +77,7 @@ class ExpertParallel:
         # (the canonical α≈0.01); pass 0.0 to disable.
         self._loss_fn = make_loss_fn(model, aux_loss_weight=aux_loss_weight)
         self._sync_each_step = serialize_dispatch(mesh)
+        self._eval_step = None
         # Specs derive from the model structure alone (eval_shape — no
         # compute), so step functions can be built before/without
         # create_state, e.g. when restoring a checkpointed TrainState.
@@ -118,6 +119,41 @@ class ExpertParallel:
             out_specs=P(self.axis_name),
         )
         return jax.jit(fwd)
+
+    def make_eval_step(self) -> Callable:
+        """Jitted sharded eval: (params, model_state, x, labels) →
+        (correct, count) summed over the expert-data shards. Cached on the
+        engine so repeated evaluate() calls reuse one compiled program."""
+        if self._eval_step is None:
+
+            def spmd(params, model_state, x, labels):
+                logits, _ = self.model.apply(params, model_state, x, train=False)
+                correct = jnp.sum(
+                    (jnp.argmax(logits, -1) == labels).astype(jnp.int32)
+                )
+                return (
+                    lax.psum(correct, self.axis_name),
+                    lax.psum(labels.size, self.axis_name),
+                )
+
+            axis = self.axis_name
+            self._eval_step = jax.jit(
+                shard_map_fn(
+                    spmd,
+                    self.mesh,
+                    in_specs=(
+                        self._specs.params,
+                        self._specs.model_state,
+                        P(axis),
+                        P(axis),
+                    ),
+                    out_specs=(P(), P()),
+                )
+            )
+        return self._eval_step
+
+    def evaluate(self, ts: TrainState, loader) -> float:
+        return evaluate_counts(self.make_eval_step(), ts, loader)
 
     def make_train_step(self) -> Callable:
         axis = self.axis_name

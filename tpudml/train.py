@@ -188,6 +188,18 @@ def make_eval_step(model: Module) -> Callable:
     return step
 
 
+def evaluate_counts(step: Callable, ts: TrainState, loader) -> float:
+    """Accuracy from a compiled (params, model_state, x, labels) →
+    (correct, count) step — the shared accumulation loop behind the
+    sharded engines' ``evaluate`` methods."""
+    correct = total = 0
+    for x, labels in loader:
+        c, n = step(ts.params, ts.model_state, jnp.asarray(x), jnp.asarray(labels))
+        correct += int(c)
+        total += int(n)
+    return correct / max(total, 1)
+
+
 def evaluate(model: Module, ts: TrainState, loader) -> float:
     """Top-1 test accuracy, reference ``test()`` parity (codes/task1/
     pytorch/model.py:67-81)."""
