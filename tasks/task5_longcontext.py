@@ -157,7 +157,9 @@ def run(args) -> dict:
     elapsed = time.time() - t0 if t0 else float("nan")
     tokens = (args.steps - steady_from) * args.batch_size * args.seq_len
     tok_per_s = tokens / elapsed if elapsed and elapsed > 0 else float("nan")
-    ppl = math.exp(min(loss, 20.0))
+    # Clamp only at the float64 exp ceiling — a diverged run should report
+    # its true (huge) perplexity, not a fabricated smaller one.
+    ppl = math.exp(min(loss, 700.0))
     print(
         f"[{args.parallel}/{args.attn or 'default'}] {len(devices)} device(s), "
         f"T={args.seq_len}: {tok_per_s:,.0f} tokens/sec, final loss {loss:.4f} "

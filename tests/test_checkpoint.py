@@ -151,7 +151,7 @@ def test_async_write_error_surfaces(tmp_path, state):
     shutil.rmtree(tmp_path / "f")
     (tmp_path / "f").write_text("not a directory")
     mgr.save(ts, 2)
-    with pytest.raises(BaseException):
+    with pytest.raises(OSError):  # makedirs over the file-at-path
         mgr.wait()
 
 

@@ -89,6 +89,9 @@ class Model:
         self.state = TrainState.create(network, optimizer, key)
         self._rng_root = jax.random.fold_in(key, 0x0D0)
         self._sink_step = None
+        self._predict = jax.jit(
+            lambda params, state, x: network.apply(params, state, x, train=False)[0]
+        )
 
     # ------------------------------------------------------------- training
 
@@ -149,11 +152,10 @@ class Model:
     # ------------------------------------------------------------ inference
 
     def predict(self, images) -> jax.Array:
-        logits, _ = self.network.apply(
-            self.state.params, self.state.model_state, jnp.asarray(images),
-            train=False,
+        """Jitted inference logits (one compiled program per input shape)."""
+        return self._predict(
+            self.state.params, self.state.model_state, jnp.asarray(images)
         )
-        return logits
 
     def eval(self, dataset: Iterable) -> dict[str, float]:
         """Metric-name → value over ``dataset`` (capitalized keys, as the

@@ -2,10 +2,12 @@
 
 from __future__ import annotations
 
+import atexit
 import json
 import os
 import re
 import shutil
+import sys
 import tempfile
 import threading
 from typing import Any, Callable
@@ -197,6 +199,16 @@ class CheckpointManager:
         self.async_write = async_write
         self._pending: threading.Thread | None = None
         self._pending_error: list[BaseException] = []
+        if async_write:
+            # A failed FINAL save must not vanish at interpreter exit: the
+            # shutdown join alone would discard the stored exception.
+            atexit.register(self._warn_on_exit)
+
+    def _warn_on_exit(self) -> None:
+        try:
+            self.wait()
+        except BaseException as e:  # stderr is all we have at exit
+            print(f"[tpudml.checkpoint] final async save FAILED: {e!r}", file=sys.stderr)
 
     def wait(self) -> None:
         """Block until an in-flight async save (if any) has hit disk;
@@ -218,6 +230,7 @@ class CheckpointManager:
         leaves = [_fetch_leaf(x) for x in jax.tree.leaves(tree)]
         treedef = jax.tree.structure(tree)
         snapshot = jax.tree.unflatten(treedef, leaves)
+        metadata = dict(metadata) if metadata else None  # snapshot by value
         path = os.path.join(self.directory, f"step_{step}")
 
         def write():

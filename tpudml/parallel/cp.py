@@ -37,7 +37,11 @@ from tpudml.nn.attention import NEG_INF
 from tpudml.nn.layers import Module
 from tpudml.nn.losses import accuracy, softmax_cross_entropy
 from tpudml.optim import Optimizer
-from tpudml.parallel.sharding import serialize_dispatch, shard_map_fn
+from tpudml.parallel.sharding import (
+    make_counting_eval_step,
+    serialize_dispatch,
+    shard_map_fn,
+)
 from tpudml.train import TrainState, evaluate_counts
 
 PyTree = Any
@@ -208,24 +212,8 @@ class ContextParallel:
         compiled program."""
         if self._eval_step is None:
             spec = self._batch_spec()
-
-            def spmd(params, model_state, tokens, labels):
-                logits, _ = self.model.apply(
-                    params, model_state, tokens, train=False
-                )
-                correct = jnp.sum(
-                    (jnp.argmax(logits, -1) == labels).astype(jnp.int32)
-                )
-                axes = self._mean_axes()
-                return lax.psum(correct, axes), lax.psum(labels.size, axes)
-
-            self._eval_step = jax.jit(
-                shard_map_fn(
-                    spmd,
-                    self.mesh,
-                    in_specs=(P(), P(), spec, spec),
-                    out_specs=(P(), P()),
-                )
+            self._eval_step = make_counting_eval_step(
+                self.model, self.mesh, (P(), P(), spec, spec), self._mean_axes()
             )
         return self._eval_step
 

@@ -29,7 +29,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from tpudml.nn.layers import Module
 from tpudml.nn.losses import accuracy
 from tpudml.optim import Optimizer
-from tpudml.parallel.sharding import serialize_dispatch, shard_map_fn
+from tpudml.parallel.sharding import (
+    make_counting_eval_step,
+    serialize_dispatch,
+    shard_map_fn,
+)
 from tpudml.train import TrainState, evaluate_counts, make_loss_fn
 
 PyTree = Any
@@ -125,30 +129,12 @@ class ExpertParallel:
         (correct, count) summed over the expert-data shards. Cached on the
         engine so repeated evaluate() calls reuse one compiled program."""
         if self._eval_step is None:
-
-            def spmd(params, model_state, x, labels):
-                logits, _ = self.model.apply(params, model_state, x, train=False)
-                correct = jnp.sum(
-                    (jnp.argmax(logits, -1) == labels).astype(jnp.int32)
-                )
-                return (
-                    lax.psum(correct, self.axis_name),
-                    lax.psum(labels.size, self.axis_name),
-                )
-
             axis = self.axis_name
-            self._eval_step = jax.jit(
-                shard_map_fn(
-                    spmd,
-                    self.mesh,
-                    in_specs=(
-                        self._specs.params,
-                        self._specs.model_state,
-                        P(axis),
-                        P(axis),
-                    ),
-                    out_specs=(P(), P()),
-                )
+            self._eval_step = make_counting_eval_step(
+                self.model,
+                self.mesh,
+                (self._specs.params, self._specs.model_state, P(axis), P(axis)),
+                axis,
             )
         return self._eval_step
 

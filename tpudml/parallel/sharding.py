@@ -44,6 +44,24 @@ def serialize_dispatch(mesh: Mesh) -> bool:
     return all(d.platform == "cpu" for d in mesh.devices.flat)
 
 
+def make_counting_eval_step(model, mesh: Mesh, in_specs, axes):
+    """Jitted sharded eval kernel shared by the parallel engines:
+    (params, model_state, x, labels) → (correct, count), psum-ed over
+    ``axes``. ``in_specs`` = (param_specs, state_specs, batch_spec,
+    batch_spec)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    def spmd(params, model_state, x, labels):
+        logits, _ = model.apply(params, model_state, x, train=False)
+        correct = jnp.sum((jnp.argmax(logits, -1) == labels).astype(jnp.int32))
+        return lax.psum(correct, axes), lax.psum(labels.size, axes)
+
+    return jax.jit(
+        shard_map_fn(spmd, mesh, in_specs=in_specs, out_specs=(P(), P()))
+    )
+
+
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
