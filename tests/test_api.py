@@ -57,6 +57,30 @@ def test_sink_and_eager_modes_match():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7)
 
 
+def test_mesh_auto_parallel_matches_single_device():
+    """Model(mesh=...) trains DataParallel under the same facade — the
+    MindSpore auto-parallel analogue — and matches the single-device
+    trajectory."""
+    from tpudml.core.config import MeshConfig
+    from tpudml.core.dist import make_mesh
+
+    loader = DataLoader(_dataset(128), 32)
+    mesh = make_mesh(MeshConfig({"data": 4}), jax.devices()[:4])
+    auto = Model(ForwardMLP(), optimizer=make_optimizer("sgd", 0.05), seed=7,
+                 mesh=mesh)
+    single = Model(ForwardMLP(), optimizer=make_optimizer("sgd", 0.05), seed=7)
+    auto.train(2, loader)
+    single.train(2, loader)
+    for a, b in zip(
+        jax.tree.leaves(auto.state.params), jax.tree.leaves(single.state.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6)
+    with pytest.raises(ValueError, match="eager mode"):
+        auto.train(1, loader, dataset_sink_mode=False)
+    with pytest.raises(ValueError, match="not divisible"):
+        auto.train(1, DataLoader(_dataset(60), 30))  # 30 % 4 != 0
+
+
 def test_predict_shape():
     model = Model(ForwardMLP(), optimizer=make_optimizer("sgd", 0.01))
     x = np.zeros((5, 28, 28, 1), np.float32)

@@ -73,6 +73,7 @@ class Model:
         optimizer: Optimizer | None = None,
         metrics: Sequence[str] | set[str] = ("accuracy",),
         seed: int = 0,
+        mesh=None,
     ):
         if optimizer is None:
             raise ValueError("Model needs an optimizer")
@@ -86,9 +87,23 @@ class Model:
         self.optimizer = optimizer
         self.metrics = tuple(m.lower() for m in metrics)
         key = jax.random.key(seed)
-        self.state = TrainState.create(network, optimizer, key)
         self._rng_root = jax.random.fold_in(key, 0x0D0)
         self._sink_step = None
+        # ``mesh`` is the auto-parallel analogue of the MindSpore track
+        # (sections/mindspore.tex:39): hand the facade a device mesh and
+        # sink-mode training becomes the DataParallel SPMD engine — same
+        # API, every chip used, gradients aggregated per step.
+        self.mesh = mesh
+        if mesh is not None:
+            from tpudml.parallel.dp import DataParallel
+
+            self._engine = DataParallel(
+                network, optimizer, mesh, rng_root=self._rng_root
+            )
+            self.state = self._engine.create_state(key)
+        else:
+            self._engine = None
+            self.state = TrainState.create(network, optimizer, key)
         self._predict = jax.jit(
             lambda params, state, x: network.apply(params, state, x, train=False)[0]
         )
@@ -122,10 +137,15 @@ class Model:
         iterable of (images, labels); DataLoader supported incl.
         set_epoch). Returns self for chaining."""
         callbacks = list(callbacks or [])
+        if not dataset_sink_mode and self._engine is not None:
+            raise ValueError("eager mode is single-device; drop mesh= to use it")
         if dataset_sink_mode and self._sink_step is None:
-            self._sink_step = make_train_step(
-                self.network, self.optimizer, rng_root=self._rng_root
-            )
+            if self._engine is not None:
+                self._sink_step = self._engine.make_train_step()
+            else:
+                self._sink_step = make_train_step(
+                    self.network, self.optimizer, rng_root=self._rng_root
+                )
         step_fn = self._sink_step if dataset_sink_mode else self._eager_step
         for cb in callbacks:
             cb.on_train_begin(self)
@@ -136,6 +156,12 @@ class Model:
                 dataset.set_epoch(epoch)
             loss = float("nan")
             for images, labels in dataset:
+                if self._engine is not None and len(images) % self._engine.world:
+                    raise ValueError(
+                        f"batch of {len(images)} rows is not divisible by the "
+                        f"{self._engine.world}-way data mesh; pick a divisible "
+                        "batch_size (with drop_remainder) when using mesh="
+                    )
                 self.state, metrics = step_fn(self.state, images, labels)
                 counter += 1
                 loss = float(metrics["loss"])
