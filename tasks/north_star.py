@@ -67,7 +67,7 @@ def run(cfg: TrainConfig, compute_dtype=jnp.bfloat16) -> dict:
         compute_dtype=compute_dtype, in_channels=train_set.images.shape[-1]
     )
     optimizer = make_optimizer(cfg.optimizer, cfg.lr, cfg.momentum)
-    dp = DataParallel(model, optimizer, mesh)
+    dp = DataParallel(model, optimizer, mesh, accum_steps=cfg.accum_steps)
     ts = dp.create_state(seed_key(cfg.seed))
     step = dp.make_train_step()
 
@@ -83,7 +83,6 @@ def run(cfg: TrainConfig, compute_dtype=jnp.bfloat16) -> dict:
         log_every=cfg.log_every,
         step_fn=step,
         state=ts,
-        accum_steps=cfg.accum_steps,
     )
     train_time = time.time() - t0
     global_batch = cfg.data.batch_size * world

@@ -67,7 +67,7 @@ def run(cfg: TrainConfig) -> dict:
 
     model = lenet_stages(in_channels=train_set.images.shape[-1])
     optimizer = make_optimizer(cfg.optimizer, cfg.lr, cfg.momentum)
-    mp = GSPMDParallel(model, optimizer, mesh)
+    mp = GSPMDParallel(model, optimizer, mesh, accum_steps=cfg.accum_steps)
     ts = mp.create_state(seed_key(cfg.seed))
     step = mp.make_train_step()
 
@@ -75,7 +75,6 @@ def run(cfg: TrainConfig) -> dict:
     ts, metrics = train_loop(
         model, optimizer, train_loader, cfg.epochs, seed_key(cfg.seed),
         writer=writer, log_every=cfg.log_every, step_fn=step, state=ts,
-        accum_steps=cfg.accum_steps,
     )
 
     eval_step = mp.make_eval_step()

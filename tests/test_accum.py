@@ -77,6 +77,28 @@ def test_split_step_honors_accum(batch):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6)
 
 
+def test_gspmd_with_accum_matches_plain(batch):
+    from tpudml.models import lenet_stages
+    from tpudml.parallel.mp import GSPMDParallel
+
+    images, labels = batch
+    model = lenet_stages()
+    opt = make_optimizer("sgd", 0.05, momentum=0.9)
+    mesh = make_mesh(MeshConfig({"stage": 2}), jax.devices()[:2])
+    states = []
+    for accum in (1, 4):
+        mp = GSPMDParallel(model, opt, mesh, accum_steps=accum)
+        ts = mp.create_state(seed_key(3))
+        step = mp.make_train_step()
+        for _ in range(2):
+            ts, _ = step(ts, images, labels)
+        states.append(ts)
+    for a, b in zip(
+        jax.tree.leaves(states[0].params), jax.tree.leaves(states[1].params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6)
+
+
 def test_indivisible_batch_raises(batch):
     images, labels = batch
     model = LeNet()

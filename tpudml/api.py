@@ -98,7 +98,7 @@ class Model:
             from tpudml.parallel.dp import DataParallel
 
             self._engine = DataParallel(
-                network, optimizer, mesh, rng_root=self._rng_root
+                network, optimizer, mesh, rng_root=self._rng_root, loss=loss_fn
             )
             self.state = self._engine.create_state(key)
         else:
@@ -144,7 +144,10 @@ class Model:
                 self._sink_step = self._engine.make_train_step()
             else:
                 self._sink_step = make_train_step(
-                    self.network, self.optimizer, rng_root=self._rng_root
+                    self.network,
+                    self.optimizer,
+                    rng_root=self._rng_root,
+                    loss=self.loss_fn,
                 )
         step_fn = self._sink_step if dataset_sink_mode else self._eager_step
         for cb in callbacks:
@@ -156,12 +159,6 @@ class Model:
                 dataset.set_epoch(epoch)
             loss = float("nan")
             for images, labels in dataset:
-                if self._engine is not None and len(images) % self._engine.world:
-                    raise ValueError(
-                        f"batch of {len(images)} rows is not divisible by the "
-                        f"{self._engine.world}-way data mesh; pick a divisible "
-                        "batch_size (with drop_remainder) when using mesh="
-                    )
                 self.state, metrics = step_fn(self.state, images, labels)
                 counter += 1
                 loss = float(metrics["loss"])

@@ -68,6 +68,7 @@ class DataParallel:
         bottleneck_delay_s: float = 0.1,
         rng_root: jax.Array | None = None,
         accum_steps: int = 1,
+        loss: Callable | None = None,
     ):
         self.model = model
         self.optimizer = optimizer
@@ -82,7 +83,9 @@ class DataParallel:
         self.accum_steps = accum_steps
         self.comm_stats = CommStats()
         self.world = mesh.shape[axis_name]
-        self._loss_fn = make_loss_fn(model)
+        self._loss_fn = (
+            make_loss_fn(model, loss) if loss is not None else make_loss_fn(model)
+        )
         self._sync_each_step = serialize_dispatch(mesh)
 
     # ---------------------------------------------------------------- state
@@ -125,6 +128,14 @@ class DataParallel:
         if labels.ndim == 2 and labels.shape[0] == self.world:
             images = images.reshape(-1, *images.shape[2:])
             labels = labels.reshape(-1)
+        if images.shape[0] % self.world:
+            # Catch it here (every caller: tasks, facade, direct use) with a
+            # actionable message instead of an opaque XLA sharding error.
+            raise ValueError(
+                f"global batch of {images.shape[0]} rows is not divisible by "
+                f"the {self.world}-way data mesh; pick a divisible batch_size "
+                "(drop_remainder=True avoids ragged final batches)"
+            )
         return jax.device_put(images, sharding), jax.device_put(labels, sharding)
 
     # ----------------------------------------------------------- fused step

@@ -39,10 +39,9 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpudml.nn.layers import Module
-from tpudml.nn.losses import accuracy
 from tpudml.optim import Optimizer
 from tpudml.parallel.sharding import serialize_dispatch
-from tpudml.train import TrainState, make_loss_fn
+from tpudml.train import TrainState, accumulate_grads, make_loss_fn
 
 PyTree = Any
 
@@ -175,6 +174,7 @@ class GSPMDParallel:
         axis_name: str = "stage",
         batch_axis: str | None = None,
         rng_root: jax.Array | None = None,
+        accum_steps: int = 1,
     ):
         self.model = model
         self.optimizer = optimizer
@@ -191,6 +191,7 @@ class GSPMDParallel:
         self.batch_axis = batch_axis
         self.rule = rule or stage_sharding_rules(axis_name)
         self.rng_root = rng_root
+        self.accum_steps = accum_steps
         self._loss_fn = make_loss_fn(model)
         self._specs = None  # computed at create_state
         self._sync_each_step = serialize_dispatch(mesh)
@@ -237,9 +238,10 @@ class GSPMDParallel:
             rng = None
             if self.rng_root is not None:
                 rng = jax.random.fold_in(self.rng_root, ts.step)
-            (loss, (model_state, logits)), grads = jax.value_and_grad(
-                self._loss_fn, has_aux=True
-            )(ts.params, ts.model_state, images, labels, rng)
+            grads, model_state, metrics = accumulate_grads(
+                self._loss_fn, ts.params, ts.model_state, images, labels, rng,
+                self.accum_steps,
+            )
             new_params, new_opt = self.optimizer.update(grads, ts.opt_state, ts.params)
             new_ts = TrainState(
                 params=new_params,
@@ -247,7 +249,7 @@ class GSPMDParallel:
                 opt_state=new_opt,
                 step=ts.step + 1,
             )
-            return new_ts, {"loss": loss, "accuracy": accuracy(logits, labels)}
+            return new_ts, metrics
 
         jitted = jax.jit(
             step_impl,

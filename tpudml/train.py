@@ -144,13 +144,14 @@ def make_train_step(
     optimizer: Optimizer,
     rng_root: jax.Array | None = None,
     accum_steps: int = 1,
+    loss: Callable = softmax_cross_entropy,
 ) -> Callable:
     """Jitted single-device train step: grad + optimizer update fused into
     one XLA program. ``rng_root`` (optional) seeds per-step dropout keys,
     folded with the step counter inside the program; ``accum_steps``
     splits the batch into sequential micro-batches (gradient
     accumulation) to trade step latency for activation memory."""
-    loss_fn = make_loss_fn(model)
+    loss_fn = make_loss_fn(model, loss)
 
     # Donated TrainState: in-place parameter/optimizer buffers (halves
     # their HBM traffic). The input state is CONSUMED on every backend —
