@@ -27,10 +27,10 @@ def qkv():
 
 
 @pytest.mark.parametrize("causal", [False, True])
-@pytest.mark.parametrize("block_q", [8, 16, 32])
-def test_kernel_matches_reference(qkv, causal, block_q):
+@pytest.mark.parametrize("block_q,block_k", [(8, 8), (16, 8), (32, 16), (8, 32)])
+def test_kernel_matches_reference(qkv, causal, block_q, block_k):
     q, k, v = qkv
-    got = flash_attention(q, k, v, causal=causal, block_q=block_q, interpret=True)
+    got = flash_attention(q, k, v, causal=causal, block_q=block_q, block_k=block_k, interpret=True)
     want = dot_product_attention(q, k, v, causal=causal)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-6)
 

@@ -103,6 +103,30 @@ def test_training_trajectory_parity_and_descent(batch):
     assert losses[-1] < losses[0]
 
 
+def test_remat_matches_plain(batch):
+    """remat=True recomputes tick activations in backward — identical math."""
+    x, y = batch
+    opt = make_optimizer("sgd", 0.05, momentum=0.9)
+    mesh = make_mesh(MeshConfig({"stage": STAGES}), jax.devices()[:STAGES])
+    block = Sequential((Dense(WIDTH, WIDTH), Activation(jax.nn.relu)))
+    results = []
+    for remat in (False, True):
+        pipe = GPipe(
+            block, n_microbatches=4, mesh=mesh,
+            optimizer=opt, prologue=Dense(16, WIDTH), epilogue=Dense(WIDTH, 10),
+            remat=remat,
+        )
+        ts = pipe.create_state(seed_key(4))
+        step = pipe.make_train_step()
+        for _ in range(2):
+            ts, m = step(ts, x, y)
+        results.append(ts)
+    for a, b in zip(
+        jax.tree.leaves(results[0].params), jax.tree.leaves(results[1].params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7)
+
+
 def test_batch_not_divisible_raises(batch):
     x, y = batch
     pipe = make_pipe(3)  # 16 % 3 != 0

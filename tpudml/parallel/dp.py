@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from tpudml.comm.collectives import broadcast_from, get_aggregator, pmean_tree
+from tpudml.nn.losses import softmax_cross_entropy
 from tpudml.comm.timing import CommStats
 from tpudml.core.dist import process_index
 from tpudml.nn.layers import Module
@@ -68,7 +69,7 @@ class DataParallel:
         bottleneck_delay_s: float = 0.1,
         rng_root: jax.Array | None = None,
         accum_steps: int = 1,
-        loss: Callable | None = None,
+        loss: Callable = softmax_cross_entropy,
     ):
         self.model = model
         self.optimizer = optimizer
@@ -83,9 +84,7 @@ class DataParallel:
         self.accum_steps = accum_steps
         self.comm_stats = CommStats()
         self.world = mesh.shape[axis_name]
-        self._loss_fn = (
-            make_loss_fn(model, loss) if loss is not None else make_loss_fn(model)
-        )
+        self._loss_fn = make_loss_fn(model, loss)
         self._sync_each_step = serialize_dispatch(mesh)
 
     # ---------------------------------------------------------------- state

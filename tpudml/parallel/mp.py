@@ -39,6 +39,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpudml.nn.layers import Module
+from tpudml.nn.losses import softmax_cross_entropy
 from tpudml.optim import Optimizer
 from tpudml.parallel.sharding import serialize_dispatch
 from tpudml.train import TrainState, accumulate_grads, make_loss_fn
@@ -175,6 +176,7 @@ class GSPMDParallel:
         batch_axis: str | None = None,
         rng_root: jax.Array | None = None,
         accum_steps: int = 1,
+        loss: Callable = softmax_cross_entropy,
     ):
         self.model = model
         self.optimizer = optimizer
@@ -192,7 +194,7 @@ class GSPMDParallel:
         self.rule = rule or stage_sharding_rules(axis_name)
         self.rng_root = rng_root
         self.accum_steps = accum_steps
-        self._loss_fn = make_loss_fn(model)
+        self._loss_fn = make_loss_fn(model, loss)
         self._specs = None  # computed at create_state
         self._sync_each_step = serialize_dispatch(mesh)
 

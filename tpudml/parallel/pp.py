@@ -105,8 +105,10 @@ class GPipe:
         prologue: Module | None = None,
         epilogue: Module | None = None,
         loss: Callable = softmax_cross_entropy,
+        remat: bool = False,
     ):
         self.block = block
+        self.remat = remat
         self.n_microbatches = n_microbatches
         self.mesh = mesh
         self.optimizer = optimizer
@@ -197,6 +199,12 @@ class GPipe:
                 buf = lax.ppermute(out, axis, perm)
             return (buf, outbuf), None
 
+        if self.remat:
+            # Rematerialize each pipeline tick in the backward pass: the
+            # block's activations are recomputed instead of stored — the
+            # residual memory drops from (M+S-1) tick activations to the
+            # scan carries, the standard deep-pipeline trade.
+            tick = jax.checkpoint(tick)
         (_, outbuf), _ = lax.scan(tick, (buf, outbuf), jnp.arange(M + S - 1))
         # Replicate the last stage's banked outputs to every device (mask +
         # psum lowers to a one-to-all on ICI).
