@@ -63,12 +63,26 @@ def test_gradients_match_reference(qkv):
         np.testing.assert_allclose(np.asarray(g), np.asarray(r), rtol=1e-4, atol=1e-6)
 
 
-@pytest.mark.parametrize("t,block_q,causal", [(30, 16, False), (30, 16, True), (32, 5, True)])
-def test_odd_lengths_pad_and_mask(qkv, t, block_q, causal):
+@pytest.mark.parametrize(
+    "t,block_q,block_k,causal",
+    [
+        (30, 16, 512, False),
+        (30, 16, 512, True),
+        (32, 5, 512, True),
+        # Multiple K tiles WITH K padding: the padded-tail mask must apply
+        # at global k positions across tiles (kj > 0).
+        (30, 16, 8, False),
+        (30, 16, 8, True),
+        (27, 8, 4, True),
+    ],
+)
+def test_odd_lengths_pad_and_mask(qkv, t, block_q, block_k, causal):
     """Any T works via pad-and-mask (never by shrinking the MXU block):
     padded keys get no attention mass, padded queries are sliced off."""
     q, k, v = (a[:, :t] for a in qkv)
-    got = flash_attention(q, k, v, causal=causal, block_q=block_q, interpret=True)
+    got = flash_attention(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k, interpret=True
+    )
     want = dot_product_attention(q, k, v, causal=causal)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-6)
 

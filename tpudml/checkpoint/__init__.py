@@ -19,6 +19,10 @@ Design notes (TPU-first):
   refills its leaves, so the treedef never needs serialising.
 """
 
+from tpudml.checkpoint.sharded import (
+    restore_sharded_checkpoint,
+    save_sharded_checkpoint,
+)
 from tpudml.checkpoint.store import (
     CheckpointManager,
     checkpoint_hook,
@@ -32,5 +36,7 @@ __all__ = [
     "checkpoint_hook",
     "latest_checkpoint",
     "restore_checkpoint",
+    "restore_sharded_checkpoint",
     "save_checkpoint",
+    "save_sharded_checkpoint",
 ]

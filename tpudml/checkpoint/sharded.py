@@ -1,0 +1,166 @@
+"""Sharded (per-host) checkpointing for GSPMD-partitioned state.
+
+The base store (``tpudml.checkpoint.store``) gathers every leaf to
+process 0 — right for replicated DP state, wasteful for pod-scale sharded
+state where one host cannot (and should not) hold the whole model. Here
+each process writes exactly the shards it owns:
+
+- layout: ``{dir}/step_{N}/shards_p{K}.npz`` + ``manifest_p{K}.json`` per
+  process; a shard's global placement travels with it as the per-dimension
+  [start, stop) window from ``jax.Array.addressable_shards[...].index``;
+- replicated leaves (or replicated copies of sharded ones) are written
+  once globally: only the shard with ``replica_id == 0``, by whichever
+  process owns it;
+- per-process files are written atomically (tmp + rename); the manifest
+  records ``num_processes`` so restore can verify every host's file
+  arrived before trusting the checkpoint;
+- restore reads ALL shard files and reassembles full host arrays into the
+  target pytree — placement back onto a mesh stays the caller's job
+  (``jax.device_put`` with the engine's shardings), so any process
+  topology can restore any other topology's checkpoint.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Any
+
+import jax
+import numpy as np
+
+from tpudml.checkpoint.store import _decode_leaf, _encode_leaf
+from tpudml.core.dist import process_count, process_index
+
+PyTree = Any
+
+_NPZ = "shards_p{k}.npz"
+_MANIFEST = "manifest_p{k}.json"
+
+
+def _norm_index(index, shape) -> list[list[int]]:
+    """slice-tuple → [[start, stop], ...] (full-dim slices normalized)."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        out.append([start, stop])
+    return out
+
+
+def save_sharded_checkpoint(
+    directory: str | os.PathLike, tree: PyTree, step: int
+) -> str:
+    """Write this process's shards of ``tree`` under
+    ``directory/step_{step}``; returns that path. Call on EVERY process."""
+    directory = os.fspath(directory)
+    path = os.path.join(directory, f"step_{step}")
+    os.makedirs(path, exist_ok=True)
+    proc = process_index()
+    arrays: dict[str, np.ndarray] = {}
+    meta: dict[str, Any] = {}
+    leaves = jax.tree.leaves(tree)
+    for i, leaf in enumerate(leaves):
+        if isinstance(leaf, jax.Array):
+            shards = leaf.addressable_shards
+        else:  # host-side leaf (plain numpy/scalar): process 0 owns it
+            if proc != 0:
+                continue
+            arr, desc = _encode_leaf(np.asarray(leaf))
+            key = f"leaf{i}_full"
+            arrays[key] = arr
+            meta[key] = {
+                "leaf": i,
+                "index": _norm_index(
+                    tuple(slice(None)) * np.ndim(leaf), np.shape(leaf)
+                ),
+                "desc": desc,
+            }
+            continue
+        for j, sh in enumerate(shards):
+            if sh.replica_id != 0:
+                continue  # replicated copy: written once globally
+            arr, desc = _encode_leaf(np.asarray(sh.data))
+            key = f"leaf{i}_s{j}"
+            arrays[key] = arr
+            meta[key] = {
+                "leaf": i,
+                "index": _norm_index(sh.index, leaf.shape),
+                "desc": desc,
+            }
+    fd, tmp = tempfile.mkstemp(dir=path, suffix=".tmp.npz")
+    os.close(fd)
+    try:
+        np.savez(tmp, **arrays)
+        os.replace(tmp, os.path.join(path, _NPZ.format(k=proc)))
+    except BaseException:
+        os.unlink(tmp)
+        raise
+    manifest = {
+        "step": int(step),
+        "process": proc,
+        "num_processes": process_count(),
+        "num_leaves": len(leaves),
+        "entries": meta,
+    }
+    tmp_m = os.path.join(path, f".manifest_p{proc}.tmp")
+    with open(tmp_m, "w") as f:
+        json.dump(manifest, f)
+    os.replace(tmp_m, os.path.join(path, _MANIFEST.format(k=proc)))
+    if process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"tpudml.ckpt.sharded.{step}")
+    return path
+
+
+def restore_sharded_checkpoint(path: str | os.PathLike, target: PyTree) -> PyTree:
+    """Reassemble a sharded checkpoint into full host arrays shaped like
+    ``target``. Reads every process's shard file; verifies all hosts'
+    manifests are present and every element was covered by some shard."""
+    path = os.fspath(path)
+    manifests = sorted(
+        f for f in os.listdir(path) if f.startswith("manifest_p")
+    )
+    if not manifests:
+        raise FileNotFoundError(f"no shard manifests under {path}")
+    with open(os.path.join(path, manifests[0])) as f:
+        first = json.load(f)
+    expect = first["num_processes"]
+    if len(manifests) != expect:
+        raise ValueError(
+            f"incomplete checkpoint: {len(manifests)}/{expect} process "
+            f"manifests present under {path}"
+        )
+    target_leaves, treedef = jax.tree.flatten(target)
+    if first["num_leaves"] != len(target_leaves):
+        raise ValueError(
+            f"checkpoint has {first['num_leaves']} leaves, target has "
+            f"{len(target_leaves)} — structure mismatch"
+        )
+    out = [None] * len(target_leaves)
+    filled = [None] * len(target_leaves)
+    for k in range(expect):
+        with open(os.path.join(path, _MANIFEST.format(k=k))) as f:
+            meta = json.load(f)["entries"]
+        with np.load(os.path.join(path, _NPZ.format(k=k))) as data:
+            for key, ent in meta.items():
+                i = ent["leaf"]
+                shard = _decode_leaf(data[key], ent["desc"])
+                window = tuple(slice(a, b) for a, b in ent["index"])
+                if out[i] is None:
+                    # Windows only bound shards; the target supplies the
+                    # full shape (validated below by coverage).
+                    shape = np.shape(target_leaves[i])
+                    out[i] = np.zeros(shape, shard.dtype)
+                    filled[i] = np.zeros(shape, bool)
+                out[i][window] = shard
+                filled[i][window] = True
+    for i, (leaf, mask) in enumerate(zip(out, filled)):
+        if leaf is None or not mask.all():
+            raise ValueError(
+                f"leaf {i}: checkpoint shards do not cover the full array "
+                "(corrupt or topology-incompatible checkpoint)"
+            )
+    return jax.tree.unflatten(treedef, out)
