@@ -1,8 +1,9 @@
 """Pallas fused-attention tests (interpret mode on the CPU harness).
 
-Load-bearing property: the kernel is the same function as the reference
+Load-bearing property: the kernels are the same function as the reference
 ``dot_product_attention`` — forward (all block sizes, causal on/off,
-bfloat16) and gradients (custom_vjp recompute path).
+bfloat16) and gradients via BOTH backward paths: the blocked dQ/dK/dV
+kernels (default) and the custom_vjp reference-recompute fallback.
 """
 
 import jax
@@ -49,10 +50,15 @@ def test_kernel_bfloat16(qkv):
 
 
 def test_gradients_match_reference(qkv):
+    """The recompute FALLBACK path (blocked_backward=False) — the blocked
+    kernels have their own parametrized test below."""
     q, k, v = qkv
     w = jnp.asarray(np.random.default_rng(3).normal(size=(B, T, H, D)).astype(np.float32))
     got = jax.grad(
-        lambda q, k, v: jnp.sum(flash_attention(q, k, v, causal=True, block_q=16, interpret=True) * w),
+        lambda q, k, v: jnp.sum(
+            flash_attention(q, k, v, causal=True, block_q=16, interpret=True,
+                            blocked_backward=False) * w
+        ),
         argnums=(0, 1, 2),
     )(q, k, v)
     want = jax.grad(
@@ -85,6 +91,35 @@ def test_odd_lengths_pad_and_mask(qkv, t, block_q, block_k, causal):
     )
     want = dot_product_attention(q, k, v, causal=causal)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-6)
+
+
+@pytest.mark.parametrize(
+    "t,block_q,block_k,causal",
+    [(32, 8, 8, False), (32, 8, 8, True), (30, 16, 8, True), (27, 8, 4, False)],
+)
+def test_blocked_backward_matches_reference(qkv, t, block_q, block_k, causal):
+    """The flash backward kernels (dQ, dK/dV with tile streaming) must
+    reproduce reference gradients across multi-tile grids, odd lengths,
+    and causal skipping."""
+    q, k, v = (a[:, :t] for a in qkv)
+    w = jnp.asarray(
+        np.random.default_rng(7).normal(size=q.shape).astype(np.float32)
+    )
+    got = jax.grad(
+        lambda q, k, v: jnp.sum(
+            flash_attention(
+                q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+                interpret=True,
+            ) * w
+        ),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    want = jax.grad(
+        lambda q, k, v: jnp.sum(dot_product_attention(q, k, v, causal=causal) * w),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for g, r in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r), rtol=5e-4, atol=1e-5)
 
 
 @pytest.mark.skipif(jax.default_backend() != "cpu", reason="CPU dispatch path")

@@ -1,30 +1,31 @@
-"""Fused attention kernel (Pallas, TPU) — flash-attention tiling.
+"""Fused attention kernels (Pallas, TPU) — flash-attention tiling, both
+directions.
 
-Softmax(QKᵀ)V fused into one kernel with BOTH operands blocked: the
-[T, T] score matrix never exists, and K/V stream through VMEM one
-[block_k, D] tile at a time, folded into an online softmax held in VMEM
-scratch (running max m, normalizer l, and an f32 output accumulator —
-rescaled by exp(m_prev − m_new) as new tiles arrive). Per-step VMEM is
-O(block_q·D + block_k·D), independent of T — the memory shape that makes
-very long contexts possible — and HBM traffic for scores drops from
-O(T²) to zero.
+Forward: softmax(QKᵀ)V with BOTH operands blocked — the [T, T] score
+matrix never exists. K/V stream through VMEM one [block_k, D] tile at a
+time into an online softmax held in scratch (running max m, normalizer l,
+f32 output accumulator, rescaled by exp(m_prev − m_new) per tile), and the
+row log-sum-exp is emitted as a residual. Per-step VMEM is
+O(block_q·D + block_k·D), independent of T.
 
-Grid: (batch×heads, T/block_q, T/block_k) with the K dimension innermost:
-each output block is revisited across the K steps, initialized at the
-first (``pl.when kj == 0``) and finalized (acc/l) at the last. Scores are
-computed on the MXU with f32 accumulation; masking (causal and
-sequence-padding) uses global positions so any T works via pad-and-mask.
+Backward: the flash recipe — no O(T²) transient. With the forward's
+output O and lse, and Δ = rowsum(dO ⊙ O):
 
-Backward uses recompute-through-the-reference-math (custom_vjp): exact
-gradients, O(T²) transient inside XLA — acceptable because training at
-long T runs under ring context parallelism (tpudml.parallel.cp), where
-per-shard T is short; a blocked backward kernel is the natural next step.
+- dQ kernel (K innermost): recompute the tile's scores, p = exp(s − lse),
+  dp = dO·Vᵀ, ds = p ⊙ (dp − Δ); accumulate dQ += scale · ds·K in scratch.
+- dK/dV kernel (Q innermost): same recompute per tile; dV += pᵀ·dO,
+  dK += scale · dsᵀ·Q.
 
-Validated against the reference math on a real v5e chip (bf16
-max-abs-err ~1e-2 vs f32 reference — MXU input precision — and ~5e-3 for
-f32 inputs). On non-TPU platforms ``flash_attention`` dispatches to the
-reference math (full speed under XLA); the interpreter runs only when
-forced (tests).
+Causal runs skip tiles entirely off the diagonal in all three kernels
+(~2× fewer FLOPs). Q and K pad independently to their own block
+multiples; masking uses global positions so any T works. Grid reads are
+hoisted out of skip branches (program_id can't lower inside a cond in
+interpret mode). ``blocked_backward=False`` falls back to
+recompute-through-the-reference-math under vjp (debugging aid).
+
+Validated against the reference math on a real v5e chip; on non-TPU
+platforms ``flash_attention`` dispatches to the reference math unless
+``interpret=True`` forces the Pallas interpreter (tests).
 """
 
 from __future__ import annotations
@@ -43,11 +44,53 @@ def _round_up(x: int, m: int) -> int:
     return -(-x // m) * m
 
 
-def _attn_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                 scale: float, causal: bool, block_q: int, block_k: int,
-                 t_valid: int):
-    # Grid reads hoisted out of the conditional body: program_id has no
-    # lowering inside a cond branch in interpret mode.
+def _plan(t: int, block_q: int, block_k: int) -> tuple[int, int, int, int]:
+    """(block_q, block_k, t_pad_q, t_pad_k): blocks are capped from above
+    at round_up(t, 8) (so tiny T doesn't allocate oversized tiles), never
+    raised — callers control the lower bound; Q/K pad independently."""
+    block_q = min(block_q, _round_up(t, 8))
+    block_k = min(block_k, _round_up(t, 8))
+    return block_q, block_k, _round_up(t, block_q), _round_up(t, block_k)
+
+
+def _fold_pad(arrays, b, h, t, d, t_pad):
+    """[B, T, H, D] → [B·H, T_pad, D] per array (shared by fwd/bwd so the
+    layouts can never diverge)."""
+    out = []
+    for x in arrays:
+        f = x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+        if t_pad != t:
+            f = jnp.pad(f, ((0, 0), (0, t_pad - t), (0, 0)))
+        out.append(f)
+    return out
+
+
+def _unfold(x, b, h, t, d):
+    return x[:, :t].reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def _scores(q, k, qi, kj, *, scale, causal, block_q, block_k, t_valid, nk):
+    """Recomputable masked score tile [block_q, block_k] in f32."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    k_pos = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    if causal:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    if t_valid != block_k * nk:  # static: nk is a trace-time constant
+        # Padded keys (K rounded up to its tile multiple) must get no
+        # attention mass; padded Q rows are sliced off outside.
+        s = jnp.where(k_pos < t_valid, s, NEG_INF)
+    return s
+
+
+# --------------------------------------------------------------- forward
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
+                scale: float, causal: bool, block_q: int, block_k: int,
+                t_valid: int):
     kj = pl.program_id(2)
     qi = pl.program_id(1)
     nk = pl.num_programs(2)
@@ -59,28 +102,16 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         l_ref[:] = jnp.zeros_like(l_ref)
 
     def fold_block():
-        q = q_ref[0]  # [block_q, D]
-        k = k_ref[0]  # [block_k, D]
-        v = v_ref[0]  # [block_k, D]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale  # [block_q, block_k] on the MXU
-        k_pos = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, s.shape, 0
-            )
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        if t_valid != block_k * nk:  # static: nk is a trace-time constant
-            # Padded keys (K rounded up to its tile multiple) must get no
-            # attention mass; padded Q rows are sliced off outside.
-            s = jnp.where(k_pos < t_valid, s, NEG_INF)
-
+        s = _scores(
+            q_ref[0], k_ref[0], qi, kj, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, t_valid=t_valid, nk=nk,
+        )
         m_prev = m_ref[:]  # [block_q, 1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m_prev - m_new)
         l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        v = v_ref[0]
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -88,53 +119,44 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         m_ref[:] = m_new
 
     if causal:
-        # Skip K blocks entirely above the diagonal (the standard causal
-        # flash-attention ~2× FLOP saving): block (i, kj) contributes only
-        # if its first key position can be attended by its last query row.
-        last_q = (qi + 1) * block_q - 1
-        pl.when(last_q >= kj * block_k)(fold_block)
+        # Skip K tiles entirely above the diagonal: tile (qi, kj)
+        # contributes only if its last query row can attend its first key.
+        pl.when((qi + 1) * block_q - 1 >= kj * block_k)(fold_block)
     else:
         fold_block()
 
     @pl.when(kj == nk - 1)
     def _():
         o_ref[0] = (acc_ref[:] / l_ref[:]).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[:] + jnp.log(l_ref[:])
 
 
-def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
-                   interpret: bool):
+def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
+    """Returns (out [B,T,H,D], lse [B·H, t_pad_q, 1] f32)."""
     b, t, h, d = q.shape
     scale = 1.0 / (d ** 0.5)
-    # Any T works: Q and K/V pad INDEPENDENTLY to their own block
-    # multiples (nothing requires equal lengths — masking uses global
-    # positions), so neither grid axis inflates past one extra block.
-    # Never shrink blocks — small tiles waste the MXU's 8-sublane
-    # granularity on odd/prime T.
-    block_q = min(block_q, _round_up(t, 8))
-    block_k = min(block_k, _round_up(t, 8))
-    t_pad_q = _round_up(t, block_q)
-    t_pad_k = _round_up(t, block_k)
-    # [B, T, H, D] → [B·H, T_pad, D]: one grid row per (batch, head).
-    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-    qf, kf, vf = fold(q), fold(k), fold(v)
-    if t_pad_q != t:
-        qf = jnp.pad(qf, ((0, 0), (0, t_pad_q - t), (0, 0)))
-    if t_pad_k != t:
-        pad = ((0, 0), (0, t_pad_k - t), (0, 0))
-        kf, vf = jnp.pad(kf, pad), jnp.pad(vf, pad)
-    out = pl.pallas_call(
+    block_q, block_k, t_pad_q, t_pad_k = _plan(t, block_q, block_k)
+    (qf,) = _fold_pad((q,), b, h, t, d, t_pad_q)
+    kf, vf = _fold_pad((k, v), b, h, t, d, t_pad_k)
+    out, lse = pl.pallas_call(
         partial(
-            _attn_kernel, scale=scale, causal=causal, block_q=block_q,
+            _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
             block_k=block_k, t_valid=t,
         ),
-        out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
+        out_shape=[
+            jax.ShapeDtypeStruct(qf.shape, q.dtype),
+            jax.ShapeDtypeStruct((b * h, t_pad_q, 1), jnp.float32),
+        ],
         grid=(b * h, t_pad_q // block_q, t_pad_k // block_k),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i, kj: (bh, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, i, kj: (bh, kj, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, i, kj: (bh, kj, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i, kj: (bh, i, 0)),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i, kj: (bh, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, i, kj: (bh, i, 0)),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),  # output accumulator
             pltpu.VMEM((block_q, 1), jnp.float32),  # running max
@@ -142,22 +164,180 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
         ],
         interpret=interpret,
     )(qf, kf, vf)
-    return out[:, :t].reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    return _unfold(out, b, h, t, d), lse
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, block_q, block_k, interpret):
-    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+# -------------------------------------------------------------- backward
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-    return _flash_forward(q, k, v, causal, block_q, block_k, interpret), (q, k, v)
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               acc_ref, *, scale, causal, block_q, block_k, t_valid):
+    kj = pl.program_id(2)
+    qi = pl.program_id(1)
+    nk = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    def fold_block():
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        s = _scores(
+            q_ref[0], k, qi, kj, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, t_valid=t_valid, nk=nk,
+        )
+        p = jnp.exp(s - lse_ref[0])  # lse_ref[0]: [bq, 1]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta_ref[0])
+        acc_ref[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        pl.when((qi + 1) * block_q - 1 >= kj * block_k)(fold_block)
+    else:
+        fold_block()
+
+    @pl.when(kj == nk - 1)
+    def _():
+        dq_ref[0] = (acc_ref[:] * scale).astype(dq_ref.dtype)
 
 
-def _flash_bwd(causal, block_q, block_k, interpret, res, g):
+def _dkdv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
+                 dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, block_q,
+                 block_k, t_valid, nk):
+    qi = pl.program_id(2)
+    kj = pl.program_id(1)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def fold_block():
+        q = q_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        s = _scores(
+            q, k_ref[0], qi, kj, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, t_valid=t_valid, nk=nk,
+        )
+        p = jnp.exp(s - lse_ref[0])  # lse_ref[0]: [bq, 1]
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # pᵀ·dO → [bk, d]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta_ref[0])
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # dsᵀ·Q → [bk, d]
+
+    if causal:
+        pl.when((qi + 1) * block_q - 1 >= kj * block_k)(fold_block)
+    else:
+        fold_block()
+
+    @pl.when(qi == nq - 1)
+    def _():
+        dk_ref[0] = (dk_acc[:] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
+    b, t, h, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    block_q, block_k, t_pad_q, t_pad_k = _plan(t, block_q, block_k)
+    qf, dof, of = _fold_pad((q, g, o), b, h, t, d, t_pad_q)
+    kf, vf = _fold_pad((k, v), b, h, t, d, t_pad_k)
+    # Δ = rowsum(dO ⊙ O): cheap elementwise, computed once outside.
+    delta = jnp.sum(
+        dof.astype(jnp.float32) * of.astype(jnp.float32), axis=-1, keepdims=True
+    )  # [B·H, t_pad_q, 1]
+
+    bh = b * h
+    nq, nk = t_pad_q // block_q, t_pad_k // block_k
+    q_spec = pl.BlockSpec((1, block_q, d), lambda i, j, r: (i, j, 0))
+    row_spec = pl.BlockSpec((1, block_q, 1), lambda i, j, r: (i, j, 0))
+
+    dqf = pl.pallas_call(
+        partial(
+            _dq_kernel, scale=scale, causal=causal, block_q=block_q,
+            block_k=block_k, t_valid=t,
+        ),
+        out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
+        grid=(bh, nq, nk),
+        in_specs=[
+            q_spec,
+            pl.BlockSpec((1, block_k, d), lambda bh, i, kj: (bh, kj, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, i, kj: (bh, kj, 0)),
+            q_spec,
+            row_spec,
+            row_spec,
+        ],
+        out_specs=q_spec,
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+
+    k_spec = pl.BlockSpec((1, block_k, d), lambda bh, kj, i: (bh, kj, 0))
+    qrow_spec = pl.BlockSpec((1, block_q, d), lambda bh, kj, i: (bh, i, 0))
+    lrow_spec = pl.BlockSpec((1, block_q, 1), lambda bh, kj, i: (bh, i, 0))
+    dkf, dvf = pl.pallas_call(
+        partial(
+            _dkdv_kernel, scale=scale, causal=causal, block_q=block_q,
+            block_k=block_k, t_valid=t, nk=nk,
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct(kf.shape, k.dtype),
+            jax.ShapeDtypeStruct(vf.shape, v.dtype),
+        ],
+        grid=(bh, nk, nq),
+        in_specs=[k_spec, k_spec, qrow_spec, qrow_spec, lrow_spec, lrow_spec],
+        out_specs=[k_spec, k_spec],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(kf, vf, qf, dof, lse, delta)
+
+    return tuple(_unfold(x, b, h, t, d) for x in (dqf, dkf, dvf))
+
+
+# ------------------------------------------------------------- dispatch
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, block_q, block_k, interpret, blocked_backward):
+    out, _ = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, blocked_backward):
+    out, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    res = (q, k, v, out, lse) if blocked_backward else (q, k, v)
+    return out, res
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, blocked_backward, res, g):
+    if blocked_backward:
+        q, k, v, o, lse = res
+        return _flash_backward(
+            q, k, v, o, lse, g, causal, block_q, block_k, interpret
+        )
     q, k, v = res
-    # Exact gradients by recomputing the reference math under vjp; XLA
-    # fuses the recompute, and the forward's fused kernel is untouched.
+    # Fallback: exact gradients by recomputing the reference math under
+    # vjp (O(T²) transient inside XLA; debugging aid).
     _, vjp = jax.vjp(
         lambda q, k, v: dot_product_attention(q, k, v, causal=causal), q, k, v
     )
@@ -176,13 +356,14 @@ def flash_attention(
     block_q: int = 128,
     block_k: int = 512,
     interpret: bool | None = None,
+    blocked_backward: bool = True,
 ) -> jax.Array:
     """Fused blocked attention over [B, T, H, D]; same semantics as
-    ``dot_product_attention``. Dispatch: compiled kernel on TPU; on other
+    ``dot_product_attention``. Dispatch: compiled kernels on TPU; on other
     backends the reference math (full speed under XLA) unless
     ``interpret=True`` forces the Pallas interpreter (tests)."""
     if interpret is None:
         if jax.default_backend() != "tpu":
             return dot_product_attention(q, k, v, causal=causal)
         interpret = False
-    return _flash(q, k, v, causal, block_q, block_k, interpret)
+    return _flash(q, k, v, causal, block_q, block_k, interpret, blocked_backward)
