@@ -43,6 +43,7 @@ class TransformerBlock(Module):
     impl: str = "full"
     axis_name: str = "seq"
     remat: bool = False
+    num_kv_heads: int | None = None
     mlp_ratio: int = 4
     moe_experts: int = 0
     moe_axis: str | None = None
@@ -60,6 +61,7 @@ class TransformerBlock(Module):
                 impl=self.impl,
                 axis_name=self.axis_name,
                 remat=self.remat,
+                num_kv_heads=self.num_kv_heads,
                 dtype=self.dtype,
             ),
             "ln2": LayerNorm(d, dtype=self.dtype),
@@ -192,6 +194,7 @@ class TransformerLM(Module):
     axis_name: str = "seq"
     seq_sharded: bool = False
     remat: bool = False
+    num_kv_heads: int | None = None
     moe_experts: int = 0
     moe_axis: str | None = None
     moe_capacity_factor: float = 2.0
@@ -205,6 +208,7 @@ class TransformerLM(Module):
             impl=self.impl,
             axis_name=self.axis_name,
             remat=self.remat,
+            num_kv_heads=self.num_kv_heads,
             moe_experts=self.moe_experts,
             moe_axis=self.moe_axis,
             moe_capacity_factor=self.moe_capacity_factor,

@@ -71,6 +71,7 @@ class MultiHeadAttention(Module):
     impl: str = "full"
     axis_name: str = "seq"
     remat: bool = False  # ring impl: rematerialize ticks in backward
+    num_kv_heads: int | None = None  # GQA/MQA: K/V head groups (< num_heads)
     dtype: Any = jnp.float32
 
     def __post_init__(self):
@@ -78,31 +79,57 @@ class MultiHeadAttention(Module):
             raise ValueError(
                 f"embed_dim {self.embed_dim} % num_heads {self.num_heads} != 0"
             )
+        kv = self.num_kv_heads
+        if kv is not None and (kv < 1 or self.num_heads % kv):
+            raise ValueError(
+                f"num_kv_heads {kv} must divide num_heads {self.num_heads}"
+            )
+
+    @property
+    def _kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
 
     def init(self, key):
         # Separate q/k/v projections (not a fused [d, 3d] kernel): shards of
         # each kernel's output dim stay head-aligned under tensor
         # parallelism, so Megatron-style column sharding needs no in-layer
-        # resharding for any mesh size dividing num_heads.
+        # resharding for any mesh size dividing num_heads — for the K/V
+        # kernels under GQA that bound is num_kv_heads (a smaller mesh);
+        # otherwise apply_rules demotes K/V to replicated, which stays
+        # CORRECT (GSPMD inserts the resharding) but costs the
+        # one-allreduce-per-sublayer property. With GQA the K/V projections
+        # shrink to kv_heads·head_dim — fewer KV parameters and a
+        # kv_heads-sized cache at inference.
         kq, kk, kv, ko = jax.random.split(key, 4)
+        head_dim = self.embed_dim // self.num_heads
         proj = Dense(self.embed_dim, self.embed_dim, dtype=self.dtype)
+        kv_proj = Dense(self.embed_dim, self._kv_heads * head_dim, dtype=self.dtype)
         return {
             "q": proj.init(kq)[0],
-            "k": proj.init(kk)[0],
-            "v": proj.init(kv)[0],
+            "k": kv_proj.init(kk)[0],
+            "v": kv_proj.init(kv)[0],
             "out": proj.init(ko)[0],
         }, {}
 
-    def _heads(self, x):
+    def _heads(self, x, n_heads):
         b, t, _ = x.shape
-        return x.reshape(b, t, self.num_heads, self.embed_dim // self.num_heads)
+        return x.reshape(b, t, n_heads, self.embed_dim // self.num_heads)
 
     def apply(self, params, state, x, *, train=False, rng=None):
         b, t, _ = x.shape
-        q, k, v = (
-            self._heads(x @ params[n]["kernel"] + params[n]["bias"])
-            for n in ("q", "k", "v")
+        q = self._heads(x @ params["q"]["kernel"] + params["q"]["bias"], self.num_heads)
+        k, v = (
+            self._heads(
+                x @ params[n]["kernel"] + params[n]["bias"], self._kv_heads
+            )
+            for n in ("k", "v")
         )
+        if self._kv_heads != self.num_heads:
+            # Broadcast each KV group across its query heads; the attention
+            # ops then see ordinary per-head tensors (GQA's savings are in
+            # parameters and the inference KV cache, not this training op).
+            group = self.num_heads // self._kv_heads
+            k, v = (jnp.repeat(a, group, axis=2) for a in (k, v))
         if self.impl == "full":
             o = dot_product_attention(q, k, v, causal=self.causal)
         elif self.impl == "flash":
