@@ -88,7 +88,10 @@ def test_gqa_composes_with_tensor_parallelism(x):
         np.random.default_rng(5).integers(0, 32, size=(B, T + 1)).astype(np.int32)
     )
     xq, y = tokens[:, :-1], tokens[:, 1:]
-    base = dict(vocab_size=32, embed_dim=D, num_heads=H, num_layers=1,
+    # embed_dim=24, H=4 → head_dim=6; MQA k/v kernels are [24, 6] and
+    # 6 % 4 != 0, so apply_rules MUST demote them to replicated (the
+    # documented GQA×TP fallback) while q stays head-sharded.
+    base = dict(vocab_size=32, embed_dim=24, num_heads=H, num_layers=1,
                 max_len=T, num_kv_heads=1)
     opt = make_optimizer("sgd", 0.1)
     mesh = make_mesh(MeshConfig({"model": 4}), jax.devices()[:4])
@@ -97,6 +100,10 @@ def test_gqa_composes_with_tensor_parallelism(x):
         rule=tensor_parallel_rules("model"), axis_name="model",
     )
     ts = tp.create_state(seed_key(6))
+    attn = ts.params["block0"]["attn"]
+    # Demoted: no mesh axis on any dim (spelled P(None, None) by apply_rules).
+    assert all(a is None for a in attn["k"]["kernel"].sharding.spec)
+    assert attn["q"]["kernel"].sharding.spec == P(None, "model")
     ref_model = TransformerLM(**base)
     ref_params = jax.device_get(ts.params)
     ref_opt = opt.init(ref_params)

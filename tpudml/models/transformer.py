@@ -44,6 +44,8 @@ class TransformerBlock(Module):
     axis_name: str = "seq"
     remat: bool = False
     num_kv_heads: int | None = None
+    rope: bool = False
+    seq_sharded: bool = False
     mlp_ratio: int = 4
     moe_experts: int = 0
     moe_axis: str | None = None
@@ -62,6 +64,8 @@ class TransformerBlock(Module):
                 axis_name=self.axis_name,
                 remat=self.remat,
                 num_kv_heads=self.num_kv_heads,
+                rope=self.rope,
+                seq_sharded=self.seq_sharded,
                 dtype=self.dtype,
             ),
             "ln2": LayerNorm(d, dtype=self.dtype),
@@ -124,34 +128,42 @@ class TransformerEmbed(Module):
     max_len: int = 1024
     axis_name: str = "seq"
     seq_sharded: bool = False
+    use_pos_embed: bool = True  # False when positions come from RoPE
     dtype: Any = jnp.float32
 
     def init(self, key):
         ke, kp = jax.random.split(key)
-        return {
+        params = {
             "tok_embed": 0.02
             * jax.random.normal(ke, (self.vocab_size, self.embed_dim), self.dtype),
-            "pos_embed": 0.02
-            * jax.random.normal(kp, (self.max_len, self.embed_dim), self.dtype),
-        }, {}
+        }
+        if self.use_pos_embed:
+            params["pos_embed"] = 0.02 * jax.random.normal(
+                kp, (self.max_len, self.embed_dim), self.dtype
+            )
+        return params, {}
 
     def apply(self, params, state, tokens, *, train=False, rng=None):
         t_local = tokens.shape[1]
         t_global = (
             lax.axis_size(self.axis_name) * t_local if self.seq_sharded else t_local
         )
-        if t_global > self.max_len:
+        if self.use_pos_embed and t_global > self.max_len:
             # Trace-time guard: out-of-range gathers clamp silently under
             # jit, which would reuse pos_embed[max_len-1] for the overflow
-            # and corrupt position information without any signal.
+            # and corrupt position information without any signal. RoPE
+            # (use_pos_embed=False) has no table to overflow — lengths
+            # beyond max_len are legitimate extrapolation.
             raise ValueError(
                 f"sequence length {t_global} exceeds max_len {self.max_len}"
             )
-        offset = (
-            lax.axis_index(self.axis_name) * t_local if self.seq_sharded else 0
-        )
-        pos = offset + jnp.arange(t_local)
-        return params["tok_embed"][tokens] + params["pos_embed"][pos], state
+        h = params["tok_embed"][tokens]
+        if self.use_pos_embed:
+            offset = (
+                lax.axis_index(self.axis_name) * t_local if self.seq_sharded else 0
+            )
+            h = h + params["pos_embed"][offset + jnp.arange(t_local)]
+        return h, state
 
 
 @dataclass(frozen=True)
@@ -195,6 +207,7 @@ class TransformerLM(Module):
     seq_sharded: bool = False
     remat: bool = False
     num_kv_heads: int | None = None
+    rope: bool = False
     moe_experts: int = 0
     moe_axis: str | None = None
     moe_capacity_factor: float = 2.0
@@ -209,6 +222,8 @@ class TransformerLM(Module):
             axis_name=self.axis_name,
             remat=self.remat,
             num_kv_heads=self.num_kv_heads,
+            rope=self.rope,
+            seq_sharded=self.seq_sharded,
             moe_experts=self.moe_experts,
             moe_axis=self.moe_axis,
             moe_capacity_factor=self.moe_capacity_factor,
@@ -227,6 +242,7 @@ class TransformerLM(Module):
             self.max_len,
             axis_name=self.axis_name,
             seq_sharded=self.seq_sharded,
+            use_pos_embed=not self.rope,
             dtype=self.dtype,
         )
 
@@ -247,9 +263,8 @@ class TransformerLM(Module):
         return params, states
 
     def apply(self, params, state, tokens, *, train=False, rng=None):
-        h = self._embed()(
-            {k: params[k] for k in ("tok_embed", "pos_embed")}, tokens
-        )
+        embed_keys = ("tok_embed",) + (() if self.rope else ("pos_embed",))
+        h = self._embed()({k: params[k] for k in embed_keys}, tokens)
         block = self._block()
         new_state = {}
         for i in range(self.num_layers):

@@ -27,6 +27,25 @@ from tpudml.nn.layers import Dense, Module
 NEG_INF = -1e30  # large-finite mask value: avoids inf-inf → NaN in softmax
 
 
+def rotary_embedding(
+    x: jax.Array, positions: jax.Array, base: float = 10000.0
+) -> jax.Array:
+    """Rotary position embedding (RoPE) over [B, T, H, D_head].
+
+    ``positions`` are GLOBAL token positions [T] — under a sharded
+    sequence axis each device passes its shard's offset positions, and
+    because RoPE encodes relative position in the q·k phase difference,
+    ring/Ulysses attention then needs no further position handling.
+    """
+    d = x.shape[-1] // 2
+    freqs = base ** (-jnp.arange(d, dtype=jnp.float32) / d)  # [d]
+    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # [T, d]
+    cos = jnp.cos(angles)[None, :, None, :].astype(x.dtype)
+    sin = jnp.sin(angles)[None, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., :d], x[..., d:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+
+
 def dot_product_attention(
     q: jax.Array,
     k: jax.Array,
@@ -72,6 +91,9 @@ class MultiHeadAttention(Module):
     axis_name: str = "seq"
     remat: bool = False  # ring impl: rematerialize ticks in backward
     num_kv_heads: int | None = None  # GQA/MQA: K/V head groups (< num_heads)
+    rope: bool = False  # rotary position embeddings on q/k
+    rope_base: float = 10000.0
+    seq_sharded: bool = False  # rope offsets from axis_name when sharded
     dtype: Any = jnp.float32
 
     def __post_init__(self):
@@ -83,6 +105,13 @@ class MultiHeadAttention(Module):
         if kv is not None and (kv < 1 or self.num_heads % kv):
             raise ValueError(
                 f"num_kv_heads {kv} must divide num_heads {self.num_heads}"
+            )
+        if self.rope and (self.embed_dim // self.num_heads) % 2:
+            # RoPE rotates feature PAIRS; an odd head_dim would silently
+            # broadcast to the wrong width instead of erroring later.
+            raise ValueError(
+                f"rope requires an even head_dim, got "
+                f"{self.embed_dim // self.num_heads}"
             )
 
     @property
@@ -124,6 +153,17 @@ class MultiHeadAttention(Module):
             )
             for n in ("k", "v")
         )
+        if self.rope:
+            # Before the GQA repeat: rotating the kv_heads-wide tensor does
+            # group× less work and repeating rotated heads is identical.
+            from jax import lax
+
+            offset = (
+                lax.axis_index(self.axis_name) * t if self.seq_sharded else 0
+            )
+            positions = offset + jnp.arange(t)
+            q = rotary_embedding(q, positions, self.rope_base)
+            k = rotary_embedding(k, positions, self.rope_base)
         if self._kv_heads != self.num_heads:
             # Broadcast each KV group across its query heads; the attention
             # ops then see ordinary per-head tensors (GQA's savings are in
