@@ -1,0 +1,88 @@
+"""AdamW (decoupled weight decay) + global-norm gradient clipping tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpudml.optim import Adam, AdamW, ClipByGlobalNorm, Scheduled, Sgd, constant
+from tpudml.optim import make_optimizer
+
+
+def test_adamw_decouples_decay():
+    """AdamW == Adam followed by -lr·wd·p on the ORIGINAL params (the
+    decay never touches the moments)."""
+    params = {"w": jnp.asarray([1.0, -2.0, 3.0])}
+    grads = {"w": jnp.asarray([0.5, 0.5, -0.5])}
+    lr, wd = 0.1, 0.04
+    adam, adamw = Adam(lr=lr), AdamW(lr=lr, weight_decay=wd)
+    pa, sa = adam.update(grads, adam.init(params), params)
+    pw, sw = adamw.update(grads, adamw.init(params), params)
+    np.testing.assert_allclose(
+        np.asarray(pw["w"]), np.asarray(pa["w"]) - lr * wd * np.asarray(params["w"]),
+        rtol=1e-6,
+    )
+    # Moments identical: decay is decoupled.
+    for a, b in zip(jax.tree.leaves(sa), jax.tree.leaves(sw)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_adamw_zero_decay_is_adam():
+    params = {"w": jnp.arange(4.0)}
+    grads = {"w": jnp.ones(4)}
+    pa, _ = Adam(lr=0.1).update(grads, Adam(lr=0.1).init(params), params)
+    pw, _ = AdamW(lr=0.1, weight_decay=0.0).update(
+        grads, AdamW(lr=0.1).init(params), params
+    )
+    np.testing.assert_array_equal(np.asarray(pa["w"]), np.asarray(pw["w"]))
+
+
+def test_clip_rescales_only_above_threshold():
+    params = {"a": jnp.zeros(3), "b": jnp.zeros(2)}
+    opt = ClipByGlobalNorm(Sgd(lr=1.0), max_norm=1.0)
+    state = opt.init(params)
+
+    small = {"a": jnp.asarray([0.1, 0.2, 0.2]), "b": jnp.asarray([0.1, 0.1])}
+    p1, _ = opt.update(small, state, params)
+    np.testing.assert_allclose(  # untouched below the threshold
+        np.asarray(p1["a"]), -np.asarray(small["a"]), rtol=1e-6
+    )
+
+    big = {"a": jnp.asarray([3.0, 0.0, 0.0]), "b": jnp.asarray([0.0, 4.0])}
+    p2, _ = opt.update(big, state, params)
+    flat = np.concatenate([np.asarray(-p2["a"]), np.asarray(-p2["b"])])
+    np.testing.assert_allclose(np.linalg.norm(flat), 1.0, rtol=1e-6)  # norm 5 → 1
+    np.testing.assert_allclose(flat, np.asarray([0.6, 0, 0, 0, 0.8]), rtol=1e-6)
+
+
+def test_clip_composes_with_scheduled():
+    opt = ClipByGlobalNorm(Scheduled(Sgd(), constant(0.5)), max_norm=10.0)
+    params = {"w": jnp.ones(2)}
+    state = opt.init(params)
+    p, state = opt.update({"w": jnp.ones(2)}, state, params)
+    np.testing.assert_allclose(np.asarray(p["w"]), 0.5, rtol=1e-6)
+    assert int(state["t"]) == 1
+
+
+def test_validation_and_factory():
+    with pytest.raises(ValueError, match="base optimizer"):
+        ClipByGlobalNorm(max_norm=1.0)
+    assert isinstance(make_optimizer("adamw", 1e-3, weight_decay=0.1), AdamW)
+
+
+def test_adamw_trains_lenet():
+    from tpudml.data.datasets import synthetic_classification
+    from tpudml.models import LeNet
+    from tpudml.core.prng import seed_key
+    from tpudml.train import TrainState, make_train_step
+
+    model = LeNet()
+    opt = ClipByGlobalNorm(AdamW(lr=1e-3, weight_decay=0.01), max_norm=5.0)
+    images, labels = synthetic_classification(32, (28, 28, 1), 10, seed=0)
+    step = make_train_step(model, opt)
+    ts = TrainState.create(model, opt, seed_key(0))
+    first = None
+    for _ in range(8):
+        ts, m = step(ts, jnp.asarray(images), jnp.asarray(labels))
+        first = first if first is not None else float(m["loss"])
+    assert float(m["loss"]) < first

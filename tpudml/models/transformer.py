@@ -45,6 +45,7 @@ class TransformerBlock(Module):
     remat: bool = False
     num_kv_heads: int | None = None
     rope: bool = False
+    rope_base: float = 10000.0
     seq_sharded: bool = False
     mlp_ratio: int = 4
     moe_experts: int = 0
@@ -65,6 +66,7 @@ class TransformerBlock(Module):
                 remat=self.remat,
                 num_kv_heads=self.num_kv_heads,
                 rope=self.rope,
+                rope_base=self.rope_base,
                 seq_sharded=self.seq_sharded,
                 dtype=self.dtype,
             ),
@@ -208,6 +210,7 @@ class TransformerLM(Module):
     remat: bool = False
     num_kv_heads: int | None = None
     rope: bool = False
+    rope_base: float = 10000.0
     moe_experts: int = 0
     moe_axis: str | None = None
     moe_capacity_factor: float = 2.0
@@ -223,6 +226,7 @@ class TransformerLM(Module):
             remat=self.remat,
             num_kv_heads=self.num_kv_heads,
             rope=self.rope,
+            rope_base=self.rope_base,
             seq_sharded=self.seq_sharded,
             moe_experts=self.moe_experts,
             moe_axis=self.moe_axis,

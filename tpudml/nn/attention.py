@@ -156,10 +156,8 @@ class MultiHeadAttention(Module):
         if self.rope:
             # Before the GQA repeat: rotating the kv_heads-wide tensor does
             # group× less work and repeating rotated heads is identical.
-            from jax import lax
-
             offset = (
-                lax.axis_index(self.axis_name) * t if self.seq_sharded else 0
+                jax.lax.axis_index(self.axis_name) * t if self.seq_sharded else 0
             )
             positions = offset + jnp.arange(t)
             q = rotary_embedding(q, positions, self.rope_base)

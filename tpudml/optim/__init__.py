@@ -1,5 +1,7 @@
 from tpudml.optim.optimizers import (
     Adam,
+    AdamW,
+    ClipByGlobalNorm,
     GradientDescent,
     Optimizer,
     ReferenceAdam,
@@ -20,6 +22,8 @@ __all__ = [
     "GradientDescent",
     "Sgd",
     "Adam",
+    "AdamW",
+    "ClipByGlobalNorm",
     "ReferenceAdam",
     "make_optimizer",
     "Scheduled",

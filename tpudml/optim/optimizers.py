@@ -175,7 +175,59 @@ class ReferenceAdam(Optimizer):
         return new_params, {"m": m, "v": v}
 
 
-def make_optimizer(name: str, lr: float, momentum: float = 0.0) -> Optimizer:
+@dataclass(frozen=True)
+class AdamW(Adam):
+    """Adam with DECOUPLED weight decay (Loshchilov & Hutter): the decay
+    term applies directly to the parameters, not through the adaptive
+    moments — the default optimizer of modern transformer training."""
+
+    weight_decay: float = 0.01
+
+    def update(self, grads, state, params):
+        new_params, new_state = super().update(grads, state, params)
+        if self.weight_decay:
+            new_params = jax.tree.map(
+                lambda np_, p: np_ - self.lr * self.weight_decay * p,
+                new_params,
+                params,
+            )
+        return new_params, new_state
+
+
+@dataclass(frozen=True)
+class ClipByGlobalNorm(Optimizer):
+    """Gradient clipping wrapper: rescales the WHOLE gradient pytree when
+    its global L2 norm exceeds ``max_norm``, then defers to ``base``.
+    Composes with any optimizer (incl. ``Scheduled``); state and its
+    sharding spec pass straight through."""
+
+    base: Optimizer = None  # type: ignore[assignment]
+    max_norm: float = 1.0
+
+    def __post_init__(self):
+        if self.base is None:
+            raise ValueError("ClipByGlobalNorm needs a base optimizer")
+
+    def init(self, params):
+        return self.base.init(params)
+
+    def init_spec(self, param_specs):
+        return self.base.init_spec(param_specs)
+
+    def update(self, grads, state, params):
+        sq = sum(
+            jnp.sum(jnp.square(g.astype(jnp.float32)))
+            for g in jax.tree.leaves(grads)
+        )
+        norm = jnp.sqrt(sq)
+        scale = jnp.minimum(1.0, self.max_norm / jnp.maximum(norm, 1e-12))
+        grads = jax.tree.map(lambda g: (g * scale).astype(g.dtype), grads)
+        return self.base.update(grads, state, params)
+
+
+def make_optimizer(
+    name: str, lr: float, momentum: float = 0.0, weight_decay: float = 0.01
+) -> Optimizer:
     """Factory used by the task entrypoints' ``--optimizer`` flag."""
     name = name.lower()
     if name == "gd":
@@ -184,6 +236,8 @@ def make_optimizer(name: str, lr: float, momentum: float = 0.0) -> Optimizer:
         return Sgd(lr=lr, momentum=momentum)
     if name == "adam":
         return Adam(lr=lr)
+    if name == "adamw":
+        return AdamW(lr=lr, weight_decay=weight_decay)
     if name in ("adam_ref", "reference_adam"):
         return ReferenceAdam(lr=lr)
     raise ValueError(f"unknown optimizer {name!r}")
