@@ -15,7 +15,14 @@ next-token data with a selectable parallelism/attention strategy:
                          over a {"model": N} mesh;
 - ``--parallel pp``      micro-batched pipeline (GPipe) — one decoder block
                          per stage over a {"stage": N} mesh (depth = N;
-                         ``--num_layers`` is ignored in this mode).
+                         ``--num_layers`` is ignored in this mode);
+- ``--parallel ep``      expert parallelism — requires ``--moe_experts N``;
+                         the Switch-MoE FFN's experts shard over an
+                         {"expert": N} mesh with all_to_all dispatch.
+
+Model knobs on any strategy: ``--rope`` (rotary positions),
+``--num_kv_heads`` (GQA/MQA), ``--remat`` (ring-tick remat),
+``--moe_experts`` (Switch FFN, dense unless --parallel ep).
 
 Reports steady-state tokens/sec and final loss.
 
@@ -47,7 +54,7 @@ from tpudml.train import TrainState, make_train_step
 def parse_args(argv=None) -> argparse.Namespace:
     p = argparse.ArgumentParser()
     p.add_argument(
-        "--parallel", choices=["single", "dp", "cp", "tp", "pp"], default="single"
+        "--parallel", choices=["single", "dp", "cp", "tp", "pp", "ep"], default="single"
     )
     p.add_argument("--microbatches", type=int, default=4, help="pp micro-batches")
     p.add_argument("--attn", choices=["full", "flash", "ring", "ulysses"], default=None,
@@ -59,6 +66,10 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--embed_dim", type=int, default=128)
     p.add_argument("--num_heads", type=int, default=8)
     p.add_argument("--num_layers", type=int, default=2)
+    p.add_argument("--num_kv_heads", type=int, default=None, help="GQA/MQA")
+    p.add_argument("--rope", action="store_true", help="rotary positions")
+    p.add_argument("--remat", action="store_true", help="remat ring ticks")
+    p.add_argument("--moe_experts", type=int, default=0, help="Switch MoE FFN")
     p.add_argument("--steps", type=int, default=60)
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--log_every", type=int, default=20)
@@ -76,8 +87,29 @@ def build_engine(args, devices):
         num_heads=args.num_heads,
         num_layers=args.num_layers,
         max_len=args.seq_len,
+        num_kv_heads=args.num_kv_heads,
+        rope=args.rope,
+        remat=args.remat,
+        moe_experts=args.moe_experts,
     )
     opt = make_optimizer("adam", args.lr)
+    if args.parallel not in ("cp",) and args.attn in ("ring", "ulysses"):
+        raise ValueError(f"--attn {args.attn} requires --parallel cp")
+    if args.parallel == "ep":
+        # MoE decoder trained expert-parallel: tokens + experts share the
+        # expert axis, capacity buffers move by all_to_all.
+        if not args.moe_experts:
+            raise ValueError("--parallel ep needs --moe_experts N")
+        if args.moe_experts % n:
+            raise ValueError(
+                f"--moe_experts {args.moe_experts} must divide over {n} devices"
+            )
+        from tpudml.parallel.ep import ExpertParallel
+
+        mesh = make_mesh(MeshConfig({"expert": n}), devices)
+        model = TransformerLM(**dict(base, moe_axis="expert"), impl=args.attn or "full")
+        engine = ExpertParallel(model, opt, mesh)
+        return engine.create_state(seed_key(args.seed)), engine.make_train_step()
     if args.parallel == "cp":
         impl = args.attn or "ring"
         if impl not in ("ring", "ulysses"):
@@ -87,8 +119,6 @@ def build_engine(args, devices):
         engine = ContextParallel(model, opt, mesh)
         return engine.create_state(seed_key(args.seed)), engine.make_train_step()
     impl = args.attn or "full"
-    if impl in ("ring", "ulysses"):
-        raise ValueError(f"--attn {impl} requires --parallel cp")
     model = TransformerLM(**base, impl=impl)
     if args.parallel == "single":
         ts = TrainState.create(model, opt, seed_key(args.seed))
@@ -99,16 +129,26 @@ def build_engine(args, devices):
         return engine.create_state(seed_key(args.seed)), engine.make_train_step()
     if args.parallel == "pp":
         # One decoder block per pipeline stage; embed/head replicated.
+        # Model knobs carry over; MoE blocks are stateful (aux-loss slot)
+        # and the pipeline requires stateless blocks.
+        if args.moe_experts:
+            raise ValueError("--parallel pp does not support --moe_experts")
         from tpudml.models import TransformerBlock, TransformerEmbed, TransformerHead
         from tpudml.parallel.pp import GPipe
 
         mesh = make_mesh(MeshConfig({"stage": n}), devices)
         pipe = GPipe(
-            TransformerBlock(args.embed_dim, args.num_heads, causal=True, impl=impl),
+            TransformerBlock(
+                args.embed_dim, args.num_heads, causal=True, impl=impl,
+                num_kv_heads=args.num_kv_heads, rope=args.rope,
+            ),
             n_microbatches=args.microbatches,
             mesh=mesh,
             optimizer=opt,
-            prologue=TransformerEmbed(args.vocab, args.embed_dim, args.seq_len),
+            prologue=TransformerEmbed(
+                args.vocab, args.embed_dim, args.seq_len,
+                use_pos_embed=not args.rope,
+            ),
             epilogue=TransformerHead(args.embed_dim, args.vocab),
         )
         return pipe.create_state(seed_key(args.seed)), pipe.make_train_step()

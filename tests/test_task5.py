@@ -38,8 +38,11 @@ COMMON = [
         # model needs a few more steps to pass the same loss bar.
         ["--parallel", "pp", "--n_devices", "4", "--microbatches", "4",
          "--steps", "80"],
+        ["--parallel", "ep", "--n_devices", "4", "--moe_experts", "8"],
+        ["--parallel", "single", "--rope", "--num_kv_heads", "2"],
     ],
-    ids=["single", "dp", "cp-ring", "cp-ulysses", "tp", "pp"],
+    ids=["single", "dp", "cp-ring", "cp-ulysses", "tp", "pp", "ep-moe",
+         "rope-gqa"],
 )
 def test_strategies_learn_successor(extra):
     out = main(COMMON + extra)
