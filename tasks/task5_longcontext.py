@@ -70,6 +70,7 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--rope", action="store_true", help="rotary positions")
     p.add_argument("--remat", action="store_true", help="remat ring ticks")
     p.add_argument("--moe_experts", type=int, default=0, help="Switch MoE FFN")
+    p.add_argument("--dropout", type=float, default=0.0)
     p.add_argument("--steps", type=int, default=60)
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--log_every", type=int, default=20)
@@ -91,8 +92,10 @@ def build_engine(args, devices):
         rope=args.rope,
         remat=args.remat,
         moe_experts=args.moe_experts,
+        dropout=args.dropout,
     )
     opt = make_optimizer("adam", args.lr)
+    rng_root = jax.random.key(args.seed ^ 0xD0) if args.dropout else None
     if args.parallel not in ("cp",) and args.attn in ("ring", "ulysses"):
         raise ValueError(f"--attn {args.attn} requires --parallel cp")
     if args.parallel == "ep":
@@ -104,6 +107,8 @@ def build_engine(args, devices):
             raise ValueError(
                 f"--moe_experts {args.moe_experts} must divide over {n} devices"
             )
+        if args.dropout:
+            raise ValueError("--parallel ep does not support --dropout")
         from tpudml.parallel.ep import ExpertParallel
 
         mesh = make_mesh(MeshConfig({"expert": n}), devices)
@@ -116,16 +121,16 @@ def build_engine(args, devices):
             raise ValueError("cp needs --attn ring|ulysses")
         mesh = make_mesh(MeshConfig({"seq": n}), devices)
         model = TransformerLM(**base, impl=impl, seq_sharded=True)
-        engine = ContextParallel(model, opt, mesh)
+        engine = ContextParallel(model, opt, mesh, rng_root=rng_root)
         return engine.create_state(seed_key(args.seed)), engine.make_train_step()
     impl = args.attn or "full"
     model = TransformerLM(**base, impl=impl)
     if args.parallel == "single":
         ts = TrainState.create(model, opt, seed_key(args.seed))
-        return ts, make_train_step(model, opt)
+        return ts, make_train_step(model, opt, rng_root=rng_root)
     if args.parallel == "dp":
         mesh = make_mesh(MeshConfig({"data": n}), devices)
-        engine = DataParallel(model, opt, mesh)
+        engine = DataParallel(model, opt, mesh, rng_root=rng_root)
         return engine.create_state(seed_key(args.seed)), engine.make_train_step()
     if args.parallel == "pp":
         # One decoder block per pipeline stage; embed/head replicated.
@@ -133,6 +138,8 @@ def build_engine(args, devices):
         # and the pipeline requires stateless blocks.
         if args.moe_experts:
             raise ValueError("--parallel pp does not support --moe_experts")
+        if args.dropout:
+            raise ValueError("--parallel pp does not support --dropout")
         from tpudml.models import TransformerBlock, TransformerEmbed, TransformerHead
         from tpudml.parallel.pp import GPipe
 
@@ -155,7 +162,8 @@ def build_engine(args, devices):
     # tp
     mesh = make_mesh(MeshConfig({"model": n}), devices)
     engine = GSPMDParallel(
-        model, opt, mesh, rule=tensor_parallel_rules("model"), axis_name="model"
+        model, opt, mesh, rule=tensor_parallel_rules("model"),
+        axis_name="model", rng_root=rng_root,
     )
     return engine.create_state(seed_key(args.seed)), engine.make_train_step()
 

@@ -47,6 +47,7 @@ class TransformerBlock(Module):
     rope: bool = False
     rope_base: float = 10000.0
     seq_sharded: bool = False
+    dropout: float = 0.0  # on attention + FFN outputs (train mode, needs rng)
     mlp_ratio: int = 4
     moe_experts: int = 0
     moe_axis: str | None = None
@@ -99,12 +100,25 @@ class TransformerBlock(Module):
                 states[n] = s  # e.g. the MoE aux-loss slot
         return params, states
 
+    def _drop(self, h, train, rng, salt):
+        """Inverted dropout via the shared nn.Dropout module; the salt
+        fold keeps the attention/FFN masks independent."""
+        if not train or self.dropout == 0.0:
+            return h
+        if rng is None:
+            raise ValueError("TransformerBlock dropout requires an rng in train mode")
+        from tpudml.nn.layers import Dropout
+
+        return Dropout(self.dropout)(
+            {}, h, train=True, rng=jax.random.fold_in(rng, salt)
+        )
+
     def apply(self, params, state, x, *, train=False, rng=None):
         parts = self._parts()
         new_state = {}
         h = parts["ln1"](params["ln1"], x)
         h = parts["attn"](params["attn"], h)
-        x = x + h
+        x = x + self._drop(h, train, rng, 1)
         h = parts["ln2"](params["ln2"], x)
         if self.moe_experts:
             h, moe_state = parts["moe"].apply(
@@ -114,7 +128,7 @@ class TransformerBlock(Module):
         else:
             h = jax.nn.gelu(parts["fc1"](params["fc1"], h))
             h = parts["fc2"](params["fc2"], h)
-        return x + h, new_state
+        return x + self._drop(h, train, rng, 2), new_state
 
 
 @dataclass(frozen=True)
@@ -211,6 +225,7 @@ class TransformerLM(Module):
     num_kv_heads: int | None = None
     rope: bool = False
     rope_base: float = 10000.0
+    dropout: float = 0.0
     moe_experts: int = 0
     moe_axis: str | None = None
     moe_capacity_factor: float = 2.0
@@ -228,6 +243,7 @@ class TransformerLM(Module):
             rope=self.rope,
             rope_base=self.rope_base,
             seq_sharded=self.seq_sharded,
+            dropout=self.dropout,
             moe_experts=self.moe_experts,
             moe_axis=self.moe_axis,
             moe_capacity_factor=self.moe_capacity_factor,
@@ -274,7 +290,8 @@ class TransformerLM(Module):
         for i in range(self.num_layers):
             h, s = block.apply(
                 params[f"block{i}"], state.get(f"block{i}", {}), h,
-                train=train, rng=rng,
+                train=train,
+                rng=None if rng is None else jax.random.fold_in(rng, i),
             )
             if s:
                 new_state[f"block{i}"] = s

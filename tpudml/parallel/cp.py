@@ -165,11 +165,13 @@ class ContextParallel:
         mesh: Mesh,
         axis_name: str = "seq",
         batch_axis: str | None = None,
+        rng_root: jax.Array | None = None,
     ):
         self.model = model
         self.optimizer = optimizer
         self.mesh = mesh
         self.axis_name = axis_name
+        self.rng_root = rng_root  # per-step/per-shard dropout streams
         if batch_axis is not None and batch_axis not in mesh.shape:
             raise ValueError(
                 f"batch_axis {batch_axis!r} not in mesh axes {tuple(mesh.shape)}"
@@ -225,9 +227,18 @@ class ContextParallel:
         axis = self.axis_name
 
         def spmd(ts: TrainState, tokens, labels):
+            rng = None
+            if self.rng_root is not None:
+                # Distinct dropout streams per step AND per sequence shard
+                # (a replicated key would reuse one mask on every shard).
+                rng = jax.random.fold_in(
+                    jax.random.fold_in(self.rng_root, ts.step),
+                    lax.axis_index(axis),
+                )
+
             def loss_fn(params):
                 logits, new_state = self.model.apply(
-                    params, ts.model_state, tokens, train=True
+                    params, ts.model_state, tokens, train=True, rng=rng
                 )
                 return softmax_cross_entropy(logits, labels), (new_state, logits)
 

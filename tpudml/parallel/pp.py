@@ -127,6 +127,11 @@ class GPipe:
         stacked, states = jax.vmap(self.block.init)(stage_keys)
         if jax.tree.leaves(states):
             raise ValueError("pipeline blocks must be stateless (no BatchNorm)")
+        if getattr(self.block, "dropout", 0.0):
+            # The schedule runs blocks in inference mode (no train/rng
+            # threading through the scan); silent no-op dropout would fake
+            # regularization, so reject it loudly.
+            raise ValueError("pipeline stages do not support dropout")
         pro = self.prologue.init(kp)[0] if self.prologue is not None else {}
         epi = self.epilogue.init(ke)[0] if self.epilogue is not None else {}
         return {"prologue": pro, "stages": stacked, "epilogue": epi}
