@@ -5,25 +5,20 @@ story (mp.spawn / docker-compose, SURVEY.md §4): XLA's forced host-platform
 device count gives 8 fake devices on CPU, so every sharding/collective path
 is exercised in CI without TPU hardware.
 
-Note: platform selection uses ``jax.config.update`` rather than the
-JAX_PLATFORMS env var — in environments where a site hook imports jax at
-interpreter startup (e.g. a preloaded TPU PJRT plugin), the env var is
-already latched by the time conftest runs; the config API still works as
-long as no backend has been initialized.
+Provisioning logic lives in ``__graft_entry__._provision_cpu_mesh`` (the
+driver hook needs the identical dance, and two copies would drift); it
+defers the jax import, so it is safe to call before any backend exists and
+works even when a site hook latched JAX_PLATFORMS at interpreter startup.
 """
 
 import os
+import sys
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax  # noqa: E402
+from __graft_entry__ import _provision_cpu_mesh  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+_provision_cpu_mesh(8)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,90 @@
+"""Driver-hook regression tests.
+
+Round 1 shipped ``__graft_entry__.dryrun_multichip`` broken under the driver
+(one real chip visible, no virtual-mesh provisioning → ``mesh wants 8
+devices, have 1``) precisely because nothing in tests/ exercised the hook.
+These tests run it the way the driver does: a fresh subprocess with NO
+XLA_FLAGS / JAX_PLATFORMS in the environment, so the hook must provision
+the virtual CPU mesh itself.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _clean_env():
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "JAX_PLATFORM_NAME")
+    }
+    env["PYTHONPATH"] = REPO
+    return env
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_self_provisions():
+    """dryrun_multichip(8) must pass from a clean environment (driver mode)."""
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            "import __graft_entry__; __graft_entry__.dryrun_multichip(8)",
+        ],
+        cwd=REPO,
+        env=_clean_env(),
+        capture_output=True,
+        text=True,
+        timeout=900,
+    )
+    assert proc.returncode == 0, f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    for regime in (
+        "dp ok",
+        "dp x stage ok",
+        "pipeline ok",
+        "ring-attention cp ok",
+        "tensor-parallel ok",
+        "expert-parallel ok",
+    ):
+        assert regime in proc.stdout, f"missing regime '{regime}':\n{proc.stdout}"
+
+
+@pytest.mark.slow
+def test_entry_compiles_and_runs():
+    """entry() must return a jittable fn + example args that execute."""
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            (
+                "import jax, __graft_entry__;"
+                "fn, args = __graft_entry__.entry();"
+                "out = jax.jit(fn)(*args);"
+                "jax.block_until_ready(out);"
+                "print('entry ok', out.shape)"
+            ),
+        ],
+        cwd=REPO,
+        env=_clean_env(),
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert proc.returncode == 0, f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    assert "entry ok" in proc.stdout
+
+
+def test_dryrun_in_process_after_backend_init():
+    """The latched-backend path: jax already initialized (conftest's 8-CPU
+    mesh counts) must not break provisioning for n <= device_count."""
+    import jax
+
+    assert jax.device_count() >= 4
+    import __graft_entry__
+
+    __graft_entry__.dryrun_multichip(4)
