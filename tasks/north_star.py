@@ -67,7 +67,10 @@ def run(cfg: TrainConfig, compute_dtype=jnp.bfloat16) -> dict:
         compute_dtype=compute_dtype, in_channels=train_set.images.shape[-1]
     )
     optimizer = make_optimizer(cfg.optimizer, cfg.lr, cfg.momentum)
-    dp = DataParallel(model, optimizer, mesh, accum_steps=cfg.accum_steps)
+    dp = DataParallel(
+        model, optimizer, mesh, accum_steps=cfg.accum_steps,
+        stacked_batches=True,  # ShardedDataLoader yields [world, B, ...]
+    )
     ts = dp.create_state(seed_key(cfg.seed))
     step = dp.make_train_step()
 

@@ -88,6 +88,7 @@ def run(cfg: TrainConfig) -> dict:
         bottleneck_rank=cfg.bottleneck_rank,
         bottleneck_delay_s=cfg.bottleneck_delay_s,
         accum_steps=cfg.accum_steps,
+        stacked_batches=True,  # ShardedDataLoader yields [world, B, ...]
     )
     ts = dp.create_state(seed_key(cfg.seed))
     ts, hooks, ckpt_mgr = setup_checkpointing(cfg, ts)

@@ -137,3 +137,39 @@ def test_sharded_stacked_batch_accepted(mesh):
     ts2, m = dp.make_train_step()(ts, images, labels)
     assert int(ts2.step) == 1
     assert np.isfinite(float(m["loss"]))
+
+
+def test_lm_batch_not_mistaken_for_stacked(mesh):
+    """[B, T] token batches with B == world must NOT be flattened by the
+    stacked-form inference (they are global batches, not stacked ones)."""
+    model = LeNet()  # model unused; we only exercise shard_batch
+    dp = DataParallel(model, make_optimizer("sgd", 0.01), mesh)
+    tokens = np.ones((WORLD, 16), np.int32)
+    labels = np.ones((WORLD, 16), np.int32)
+    x, y = dp.shard_batch(tokens, labels)
+    assert x.shape == (WORLD, 16)
+    assert y.shape == (WORLD, 16)
+
+
+def test_explicit_stacked_batches_flag(mesh):
+    """stacked_batches=True flattens any [world, B, ...] form, including
+    stacked LM batches the inference can't identify; False never flattens."""
+    model = LeNet()
+    dp_t = DataParallel(
+        model, make_optimizer("sgd", 0.01), mesh, stacked_batches=True
+    )
+    tokens = np.ones((WORLD, 2, 16), np.int32)
+    x, y = dp_t.shard_batch(tokens, tokens)
+    assert x.shape == (WORLD * 2, 16)
+    assert y.shape == (WORLD * 2, 16)
+
+    dp_f = DataParallel(
+        model, make_optimizer("sgd", 0.01), mesh, stacked_batches=False
+    )
+    imgs = np.ones((WORLD, 2, 28, 28, 1), np.float32)  # would match inference
+    lbls = np.ones((WORLD, 2), np.int32)
+    x, y = dp_f.shard_batch(imgs, lbls)
+    assert x.shape == (WORLD, 2, 28, 28, 1)
+
+    with pytest.raises(ValueError, match="stacked batch leading dim"):
+        dp_t.shard_batch(np.ones((WORLD * 2, 2, 16)), np.ones((WORLD * 2, 2)))

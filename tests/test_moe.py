@@ -199,3 +199,74 @@ def test_load_balancing_loss_uniform_is_one(tokens):
     params = dict(params, router={"kernel": jnp.zeros((D, E))})
     aux = load_balancing_loss(params, tokens, E)
     np.testing.assert_allclose(float(aux), 1.0, rtol=1e-5)
+
+
+def test_aux_loss_defaults_on_for_moe_models():
+    """Dense-MoE runs outside the EP engine must still get the Switch
+    load-balancing pressure: resolve_aux_loss_weight defaults α on exactly
+    when the model contains MoE layers."""
+    from tpudml.models import TransformerLM
+    from tpudml.train import (
+        DEFAULT_MOE_AUX_WEIGHT,
+        model_has_moe,
+        resolve_aux_loss_weight,
+    )
+
+    moe_lm = TransformerLM(vocab_size=32, embed_dim=16, num_heads=2,
+                           num_layers=1, moe_experts=4)
+    plain_lm = TransformerLM(vocab_size=32, embed_dim=16, num_heads=2,
+                             num_layers=1)
+    assert model_has_moe(moe_lm)
+    assert model_has_moe(_classifier())  # Sequential-contained MoELayer
+    assert not model_has_moe(plain_lm)
+    assert resolve_aux_loss_weight(moe_lm, None) == DEFAULT_MOE_AUX_WEIGHT
+    assert resolve_aux_loss_weight(plain_lm, None) == 0.0
+    assert resolve_aux_loss_weight(moe_lm, 0.0) == 0.0  # explicit opt-out
+
+
+def test_dense_moe_train_step_applies_aux_pressure():
+    """make_train_step's objective for a MoE model includes the aux term:
+    losses diverge from an aux_loss_weight=0 run within a few steps."""
+    from tpudml.data.datasets import synthetic_classification
+    from tpudml.train import TrainState, make_train_step
+
+    images, labels = synthetic_classification(G, (28, 28, 1), 10, seed=2)
+    images, labels = jnp.asarray(images), jnp.asarray(labels)
+    model = _classifier()
+    opt = make_optimizer("sgd", 0.05)
+    step_aux = make_train_step(model, opt)            # auto: aux on
+    step_no = make_train_step(model, opt, aux_loss_weight=0.0)
+    ts_a = TrainState.create(model, opt, seed_key(0))
+    ts_n = TrainState.create(model, opt, seed_key(0))
+    diverged = False
+    for _ in range(5):
+        ts_a, ma = step_aux(ts_a, images, labels)
+        ts_n, mn = step_no(ts_n, images, labels)
+        if not np.allclose(float(ma["loss"]), float(mn["loss"])):
+            diverged = True
+    assert diverged
+
+
+def test_clip_in_ep_keeps_replicas_synced():
+    """ClipByGlobalNorm under ExpertParallel: the engine psums the squared
+    norm over the expert axis, so every device derives the SAME clip scale
+    and replicated (router/dense) parameters stay bitwise identical."""
+    from tpudml.data.datasets import synthetic_classification
+    from tpudml.optim import ClipByGlobalNorm, Sgd
+
+    images, labels = synthetic_classification(G, (28, 28, 1), 10, seed=9)
+    mesh = make_mesh(MeshConfig({"expert": W}), jax.devices()[:W])
+    # Tiny max_norm: every step clips, so an un-psum-ed norm would scale
+    # each shard differently and de-sync the replicated parameters.
+    opt = ClipByGlobalNorm(Sgd(lr=0.1), max_norm=1e-2)
+    ep = ExpertParallel(_classifier(axis_name="expert"), opt, mesh)
+    assert ep.optimizer.axes == ("expert",)  # engine rewrapped the clip
+    ts = ep.create_state(seed_key(1))
+    step = ep.make_train_step()
+    for _ in range(3):
+        ts, _ = step(ts, jnp.asarray(images), jnp.asarray(labels))
+    # Router params are replicated: every device copy must be identical.
+    router = ts.params["layer3"]["router"]["kernel"]
+    shards = [np.asarray(s.data) for s in router.addressable_shards]
+    for s in shards[1:]:
+        np.testing.assert_array_equal(shards[0], s)

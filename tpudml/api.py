@@ -157,15 +157,21 @@ class Model:
         for epoch in range(epochs):
             if hasattr(dataset, "set_epoch"):
                 dataset.set_epoch(epoch)
-            loss = float("nan")
+            metrics = None
             for images, labels in dataset:
                 self.state, metrics = step_fn(self.state, images, labels)
                 counter += 1
-                loss = float(metrics["loss"])
+                if callbacks:
+                    # Materializing the loss forces a host↔device sync; do
+                    # it only when a callback consumes it, so callback-free
+                    # training keeps sink mode's async dispatch.
+                    loss = float(metrics["loss"])
+                    for cb in callbacks:
+                        cb.on_step_end(self, counter, loss)
+            if callbacks:
+                loss = float(metrics["loss"]) if metrics is not None else float("nan")
                 for cb in callbacks:
-                    cb.on_step_end(self, counter, loss)
-            for cb in callbacks:
-                cb.on_epoch_end(self, epoch, loss)
+                    cb.on_epoch_end(self, epoch, loss)
         jax.block_until_ready(self.state.params)
         self.train_time_s = time.time() - t0
         for cb in callbacks:

@@ -7,6 +7,7 @@ from tpudml.optim.optimizers import (
     ReferenceAdam,
     Sgd,
     make_optimizer,
+    shard_aware_clip,
 )
 from tpudml.optim.schedules import (
     Scheduled,
@@ -26,6 +27,7 @@ __all__ = [
     "ClipByGlobalNorm",
     "ReferenceAdam",
     "make_optimizer",
+    "shard_aware_clip",
     "Scheduled",
     "constant",
     "cosine_decay",

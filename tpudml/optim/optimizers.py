@@ -197,12 +197,29 @@ class AdamW(Adam):
 @dataclass(frozen=True)
 class ClipByGlobalNorm(Optimizer):
     """Gradient clipping wrapper: rescales the WHOLE gradient pytree when
-    its global L2 norm exceeds ``max_norm``, then defers to ``base``.
-    Composes with any optimizer (incl. ``Scheduled``); state and its
-    sharding spec pass straight through."""
+    its global L2 norm exceeds ``max_norm``, then defers to ``base``;
+    state and its sharding spec pass straight through.
+
+    Sharded engines (the TPU-native form of the reference's
+    update-where-params-live contract, codes/task4/model.py:126) call
+    ``update`` inside shard_map with DEVICE-LOCAL gradient shards (GPipe's
+    per-stage slices, ExpertParallel's expert slices). There the norm must
+    be reduced across the mesh or each device derives a different clip
+    scale and silently de-synchronizes the replicated parameters:
+    ``axes`` names the mesh axes to psum the squared norm over, and
+    ``sharded`` (a key-path predicate) marks which leaves are local shards
+    — replicated leaves are counted once outside the psum. Engines whose
+    optimizer.update runs on shard-local gradients rewrap the clip with
+    the right axes automatically (see GPipe / ExpertParallel); engines
+    that aggregate gradients before the update (DP, CP) and GSPMD-jitted
+    engines (where ``jnp.sum`` over a sharded array is already global)
+    need no axes.
+    """
 
     base: Optimizer = None  # type: ignore[assignment]
     max_norm: float = 1.0
+    axes: tuple = ()
+    sharded: Any = None  # Callable[[key_path], bool]; None = every leaf local
 
     def __post_init__(self):
         if self.base is None:
@@ -215,14 +232,39 @@ class ClipByGlobalNorm(Optimizer):
         return self.base.init_spec(param_specs)
 
     def update(self, grads, state, params):
-        sq = sum(
-            jnp.sum(jnp.square(g.astype(jnp.float32)))
-            for g in jax.tree.leaves(grads)
-        )
+        zero = jnp.zeros((), jnp.float32)
+        if not self.axes:
+            sq = sum(
+                (jnp.sum(jnp.square(g.astype(jnp.float32)))
+                 for g in jax.tree.leaves(grads)),
+                zero,
+            )
+        else:
+            local = rep = zero
+            for path, g in jax.tree_util.tree_flatten_with_path(grads)[0]:
+                s = jnp.sum(jnp.square(g.astype(jnp.float32)))
+                if self.sharded is None or self.sharded(path):
+                    local = local + s
+                else:
+                    rep = rep + s
+            sq = jax.lax.psum(local, self.axes) + rep
         norm = jnp.sqrt(sq)
         scale = jnp.minimum(1.0, self.max_norm / jnp.maximum(norm, 1e-12))
         grads = jax.tree.map(lambda g: (g * scale).astype(g.dtype), grads)
         return self.base.update(grads, state, params)
+
+
+def shard_aware_clip(opt: Optimizer, axes: tuple, sharded) -> Optimizer:
+    """Rewrap a top-level :class:`ClipByGlobalNorm` (when the caller didn't
+    already set ``axes``) so its norm reduces across the engine's mesh axes.
+    Engines whose ``optimizer.update`` runs on device-local gradient shards
+    call this on their optimizer at construction; anything else passes
+    through untouched."""
+    if isinstance(opt, ClipByGlobalNorm) and not opt.axes:
+        import dataclasses
+
+        return dataclasses.replace(opt, axes=tuple(axes), sharded=sharded)
+    return opt
 
 
 def make_optimizer(

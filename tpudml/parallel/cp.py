@@ -42,7 +42,12 @@ from tpudml.parallel.sharding import (
     serialize_dispatch,
     shard_map_fn,
 )
-from tpudml.train import TrainState, evaluate_counts
+from tpudml.train import (
+    TrainState,
+    evaluate_counts,
+    make_loss_fn,
+    resolve_aux_loss_weight,
+)
 
 PyTree = Any
 
@@ -166,12 +171,19 @@ class ContextParallel:
         axis_name: str = "seq",
         batch_axis: str | None = None,
         rng_root: jax.Array | None = None,
+        aux_loss_weight: float | None = None,
     ):
         self.model = model
         self.optimizer = optimizer
         self.mesh = mesh
         self.axis_name = axis_name
         self.rng_root = rng_root  # per-step/per-shard dropout streams
+        # Dense-MoE runs get the Switch load-balancing pressure by default
+        # (None → α=0.01 when the model contains MoE layers).
+        self._loss_fn = make_loss_fn(
+            model, softmax_cross_entropy,
+            resolve_aux_loss_weight(model, aux_loss_weight),
+        )
         if batch_axis is not None and batch_axis not in mesh.shape:
             raise ValueError(
                 f"batch_axis {batch_axis!r} not in mesh axes {tuple(mesh.shape)}"
@@ -236,15 +248,9 @@ class ContextParallel:
                     lax.axis_index(axis),
                 )
 
-            def loss_fn(params):
-                logits, new_state = self.model.apply(
-                    params, ts.model_state, tokens, train=True, rng=rng
-                )
-                return softmax_cross_entropy(logits, labels), (new_state, logits)
-
             (loss, (model_state, logits)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True
-            )(ts.params)
+                self._loss_fn, has_aux=True
+            )(ts.params, ts.model_state, tokens, labels, rng)
             axes = self._mean_axes()
             grads = pmean_tree(grads, axes)
             # Shard-consistent model state (e.g. norm running stats), same
@@ -264,13 +270,16 @@ class ContextParallel:
             return new_ts, metrics
 
         spec = self._batch_spec()
+        # Donate the TrainState: replicated params/opt-state update in place.
+        # Input state is CONSUMED; callers must rebind ts every step.
         jitted = jax.jit(
             shard_map_fn(
                 spmd,
                 self.mesh,
                 in_specs=(P(), spec, spec),
                 out_specs=(P(), P()),
-            )
+            ),
+            donate_argnums=(0,),
         )
 
         def step(ts: TrainState, tokens, labels):

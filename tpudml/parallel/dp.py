@@ -39,7 +39,12 @@ from tpudml.parallel.sharding import (
     serialize_dispatch,
     shard_map_fn,
 )
-from tpudml.train import TrainState, accumulate_grads, make_loss_fn
+from tpudml.train import (
+    TrainState,
+    accumulate_grads,
+    make_loss_fn,
+    resolve_aux_loss_weight,
+)
 
 PyTree = Any
 
@@ -70,11 +75,17 @@ class DataParallel:
         rng_root: jax.Array | None = None,
         accum_steps: int = 1,
         loss: Callable = softmax_cross_entropy,
+        stacked_batches: bool | None = None,
+        aux_loss_weight: float | None = None,
     ):
         self.model = model
         self.optimizer = optimizer
         self.mesh = mesh
         self.axis_name = axis_name
+        # True: batches arrive in the ShardedDataLoader's stacked
+        # [world, B, ...] form; False: plain global [world×B, ...] batches;
+        # None: infer per batch (see shard_batch).
+        self.stacked_batches = stacked_batches
         self.aggregation = aggregation
         self.aggregator = get_aggregator(aggregation)
         self.measure_comm = measure_comm
@@ -84,7 +95,11 @@ class DataParallel:
         self.accum_steps = accum_steps
         self.comm_stats = CommStats()
         self.world = mesh.shape[axis_name]
-        self._loss_fn = make_loss_fn(model, loss)
+        # Dense-MoE runs get the Switch load-balancing pressure by default
+        # (None → α=0.01 when the model contains MoE layers).
+        self._loss_fn = make_loss_fn(
+            model, loss, resolve_aux_loss_weight(model, aux_loss_weight)
+        )
         self._sync_each_step = serialize_dispatch(mesh)
 
     # ---------------------------------------------------------------- state
@@ -120,13 +135,31 @@ class DataParallel:
     def shard_batch(self, images, labels):
         """Place a global [world×B, ...] host batch sharded over the data
         axis. Accepts the ShardedDataLoader's stacked [world, B, ...] form
-        too (flattened so device r receives replica r's rows)."""
+        too (flattened so device r receives replica r's rows) — explicitly
+        when the engine was built with ``stacked_batches=True``, else by
+        inference: stacked iff the leading dim is the world size AND the
+        inputs carry at least two more dims than the labels (image-shaped
+        samples). 2-D LM token batches ([B, T] inputs + [B, T] labels)
+        never match the inference even when B == world — construct with an
+        explicit ``stacked_batches`` to bypass inference entirely."""
         sharding = data_sharding(self.mesh, self.axis_name)
         images = jnp.asarray(images)
         labels = jnp.asarray(labels)
-        if labels.ndim == 2 and labels.shape[0] == self.world:
+        stacked = self.stacked_batches
+        if stacked is None:
+            stacked = (
+                labels.ndim >= 2
+                and labels.shape[0] == self.world
+                and images.ndim >= labels.ndim + 2
+            )
+        if stacked:
+            if images.shape[0] != self.world:
+                raise ValueError(
+                    f"stacked batch leading dim {images.shape[0]} != "
+                    f"{self.world}-way data mesh"
+                )
             images = images.reshape(-1, *images.shape[2:])
-            labels = labels.reshape(-1)
+            labels = labels.reshape(-1, *labels.shape[2:])
         if images.shape[0] % self.world:
             # Catch it here (every caller: tasks, facade, direct use) with a
             # actionable message instead of an opaque XLA sharding error.

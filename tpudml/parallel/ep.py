@@ -28,7 +28,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpudml.nn.layers import Module
 from tpudml.nn.losses import accuracy
-from tpudml.optim import Optimizer
+from tpudml.optim import Optimizer, shard_aware_clip
 from tpudml.parallel.sharding import (
     make_counting_eval_step,
     serialize_dispatch,
@@ -73,7 +73,13 @@ class ExpertParallel:
         aux_loss_weight: float = 1e-2,
     ):
         self.model = model
-        self.optimizer = optimizer
+        # The update runs inside shard_map with expert grads device-local:
+        # a global-norm clip must psum its norm over the expert axis
+        # (expert leaves local, router/dense replicated) or shards would
+        # clip by different scales and de-sync the replicated parameters.
+        self.optimizer = shard_aware_clip(
+            optimizer, (axis_name,), _is_expert_path
+        )
         self.mesh = mesh
         self.axis_name = axis_name
         self.world = mesh.shape[axis_name]
@@ -174,13 +180,16 @@ class ExpertParallel:
             return new_ts, metrics
 
         specs = self._specs
+        # Donate the TrainState: expert params/opt-state rewrite in place.
+        # Input state is CONSUMED; callers must rebind ts every step.
         jitted = jax.jit(
             shard_map_fn(
                 spmd,
                 self.mesh,
                 in_specs=(specs, P(axis), P(axis)),
                 out_specs=(specs, P()),
-            )
+            ),
+            donate_argnums=(0,),
         )
 
         def step(ts: TrainState, x, labels):

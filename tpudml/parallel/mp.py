@@ -42,7 +42,12 @@ from tpudml.nn.layers import Module
 from tpudml.nn.losses import softmax_cross_entropy
 from tpudml.optim import Optimizer
 from tpudml.parallel.sharding import serialize_dispatch
-from tpudml.train import TrainState, accumulate_grads, make_loss_fn
+from tpudml.train import (
+    TrainState,
+    accumulate_grads,
+    make_loss_fn,
+    resolve_aux_loss_weight,
+)
 
 PyTree = Any
 
@@ -177,6 +182,7 @@ class GSPMDParallel:
         rng_root: jax.Array | None = None,
         accum_steps: int = 1,
         loss: Callable = softmax_cross_entropy,
+        aux_loss_weight: float | None = None,
     ):
         self.model = model
         self.optimizer = optimizer
@@ -194,7 +200,11 @@ class GSPMDParallel:
         self.rule = rule or stage_sharding_rules(axis_name)
         self.rng_root = rng_root
         self.accum_steps = accum_steps
-        self._loss_fn = make_loss_fn(model, loss)
+        # Dense-MoE runs get the Switch load-balancing pressure by default
+        # (None → α=0.01 when the model contains MoE layers).
+        self._loss_fn = make_loss_fn(
+            model, loss, resolve_aux_loss_weight(model, aux_loss_weight)
+        )
         self._specs = None  # computed at create_state
         self._sync_each_step = serialize_dispatch(mesh)
 
@@ -253,10 +263,15 @@ class GSPMDParallel:
             )
             return new_ts, metrics
 
+        # Donated TrainState (as in the DP engine): params + optimizer state
+        # update in place instead of double-buffering — these are the
+        # largest live buffers on exactly this engine. Input state is
+        # CONSUMED; callers must rebind ts every step.
         jitted = jax.jit(
             step_impl,
             in_shardings=(state_shardings, batch_sharding, batch_sharding),
             out_shardings=(state_shardings, None),
+            donate_argnums=(0,),
         )
 
         def step(ts: TrainState, images, labels):

@@ -36,7 +36,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from tpudml.comm.collectives import psum_tree
 from tpudml.nn.layers import Module
 from tpudml.nn.losses import accuracy, softmax_cross_entropy
-from tpudml.optim import Optimizer
+from tpudml.optim import Optimizer, shard_aware_clip
 from tpudml.parallel.sharding import serialize_dispatch, shard_map_fn
 from tpudml.train import TrainState
 
@@ -111,7 +111,20 @@ class GPipe:
         self.remat = remat
         self.n_microbatches = n_microbatches
         self.mesh = mesh
-        self.optimizer = optimizer
+        # The update runs inside shard_map on the local [1, ...] stage
+        # slice: a global-norm clip must psum its norm over the stage axis
+        # (stage leaves local, prologue/epilogue replicated) or each stage
+        # would clip by a different scale and de-sync the replicated parts.
+        self.optimizer = (
+            shard_aware_clip(
+                optimizer,
+                (axis_name,),
+                lambda path: bool(path)
+                and getattr(path[0], "key", None) == "stages",
+            )
+            if optimizer is not None
+            else None
+        )
         self.axis_name = axis_name
         self.n_stages = mesh.shape[axis_name]
         self.prologue = prologue
@@ -266,13 +279,16 @@ class GPipe:
             opt_state=self.optimizer.init_spec(self.param_specs()),
             step=P(),
         )
+        # Donate the TrainState: per-stage params/opt-state rewrite in place.
+        # Input state is CONSUMED; callers must rebind ts every step.
         jitted = jax.jit(
             shard_map_fn(
                 spmd,
                 self.mesh,
                 in_specs=(specs, P(), P()),
                 out_specs=(specs, P()),
-            )
+            ),
+            donate_argnums=(0,),
         )
 
         def step(ts: TrainState, x, labels):

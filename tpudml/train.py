@@ -56,6 +56,37 @@ def collect_aux_losses(state: Any) -> jax.Array:
     return total
 
 
+DEFAULT_MOE_AUX_WEIGHT = 1e-2  # the canonical Switch load-balancing α
+
+
+def model_has_moe(model: Any) -> bool:
+    """Recursively detect MoE layers in a Module tree (dataclass fields and
+    tuple/list containers), so engines can default the Switch aux-loss
+    pressure on — a dense-MoE run without it lets the top-1 router collapse
+    onto one expert."""
+    import dataclasses
+
+    from tpudml.nn.moe import MoELayer
+
+    def scan(obj) -> bool:
+        if isinstance(obj, MoELayer) or getattr(obj, "moe_experts", 0):
+            return True
+        if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+            return any(scan(getattr(obj, f.name)) for f in dataclasses.fields(obj))
+        if isinstance(obj, (tuple, list)):
+            return any(scan(o) for o in obj)
+        return False
+
+    return scan(model)
+
+
+def resolve_aux_loss_weight(model: Any, aux_loss_weight: float | None) -> float:
+    """None → the canonical α for MoE-bearing models, 0 otherwise."""
+    if aux_loss_weight is not None:
+        return aux_loss_weight
+    return DEFAULT_MOE_AUX_WEIGHT if model_has_moe(model) else 0.0
+
+
 def make_loss_fn(
     model: Module,
     loss: Callable = softmax_cross_entropy,
@@ -145,13 +176,15 @@ def make_train_step(
     rng_root: jax.Array | None = None,
     accum_steps: int = 1,
     loss: Callable = softmax_cross_entropy,
+    aux_loss_weight: float | None = None,
 ) -> Callable:
     """Jitted single-device train step: grad + optimizer update fused into
     one XLA program. ``rng_root`` (optional) seeds per-step dropout keys,
     folded with the step counter inside the program; ``accum_steps``
     splits the batch into sequential micro-batches (gradient
-    accumulation) to trade step latency for activation memory."""
-    loss_fn = make_loss_fn(model, loss)
+    accumulation) to trade step latency for activation memory.
+    ``aux_loss_weight`` defaults on (α=0.01) for MoE-bearing models."""
+    loss_fn = make_loss_fn(model, loss, resolve_aux_loss_weight(model, aux_loss_weight))
 
     # Donated TrainState: in-place parameter/optimizer buffers (halves
     # their HBM traffic). The input state is CONSUMED on every backend —
