@@ -7,6 +7,9 @@ next-token data with a selectable parallelism/attention strategy:
 
 - ``--parallel single``  one chip, full or flash (Pallas) attention;
 - ``--parallel dp``      data parallel over a {"data": N} mesh;
+- ``--parallel fsdp``    ZeRO-3 fully-sharded DP — params/grads/opt-state
+  sharded over the same {"data": N} axis (all_gather on use,
+  reduce_scatter gradients, shard-local updates);
 - ``--parallel cp``      ring-attention context parallelism — the sequence
                          axis sharded over a {"seq": N} mesh, K/V blocks
                          rotating on ICI (``--attn ulysses`` for the
@@ -54,7 +57,9 @@ from tpudml.train import TrainState, make_train_step
 def parse_args(argv=None) -> argparse.Namespace:
     p = argparse.ArgumentParser()
     p.add_argument(
-        "--parallel", choices=["single", "dp", "cp", "tp", "pp", "ep"], default="single"
+        "--parallel",
+        choices=["single", "dp", "fsdp", "cp", "tp", "pp", "ep"],
+        default="single",
     )
     p.add_argument("--microbatches", type=int, default=4, help="pp micro-batches")
     p.add_argument("--attn", choices=["full", "flash", "ring", "ulysses"], default=None,
@@ -130,7 +135,17 @@ def build_engine(args, devices):
         return ts, make_train_step(model, opt, rng_root=rng_root)
     if args.parallel == "dp":
         mesh = make_mesh(MeshConfig({"data": n}), devices)
-        engine = DataParallel(model, opt, mesh, rng_root=rng_root)
+        # [B, T] token batches are never the stacked-loader form.
+        engine = DataParallel(
+            model, opt, mesh, rng_root=rng_root, stacked_batches=False
+        )
+        return engine.create_state(seed_key(args.seed)), engine.make_train_step()
+    if args.parallel == "fsdp":
+        # ZeRO-3: params/grads/opt-state sharded over the data axis too.
+        from tpudml.parallel.fsdp import FSDP
+
+        mesh = make_mesh(MeshConfig({"data": n}), devices)
+        engine = FSDP(model, opt, mesh, rng_root=rng_root)
         return engine.create_state(seed_key(args.seed)), engine.make_train_step()
     if args.parallel == "pp":
         # One decoder block per pipeline stage; embed/head replicated.

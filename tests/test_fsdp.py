@@ -1,0 +1,146 @@
+"""FSDP/ZeRO-3 engine tests on the simulated 8-device mesh.
+
+Load-bearing properties: (1) parameters AND optimizer state actually live
+sharded 1/W per device over the data axis; (2) the training math is
+exactly DP/single-device — sharding changes where bytes live, never the
+update; (3) the layout composes with tensor parallelism on a 2-D mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tpudml.core.config import MeshConfig
+from tpudml.core.dist import make_mesh
+from tpudml.core.prng import seed_key
+from tpudml.data.datasets import synthetic_classification
+from tpudml.models import ForwardMLP
+from tpudml.optim import make_optimizer
+from tpudml.parallel.dp import DataParallel
+from tpudml.parallel.fsdp import FSDP, fsdp_sharding_rules
+from tpudml.parallel.mp import tensor_parallel_rules
+
+WORLD = 8
+GLOBAL = 32
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(MeshConfig({"data": WORLD}))
+
+
+@pytest.fixture(scope="module")
+def batch():
+    images, labels = synthetic_classification(GLOBAL, (28, 28, 1), 10, seed=11)
+    return jnp.asarray(images), jnp.asarray(labels)
+
+
+def test_rule_shards_largest_divisible_dim():
+    rule = fsdp_sharding_rules("data", axis_size=8)
+    w = jax.ShapeDtypeStruct((784, 512), jnp.float32)
+    assert rule(("fc1", "kernel"), w) == P("data")  # dim 0 (784) sharded
+    b = jax.ShapeDtypeStruct((512,), jnp.float32)
+    assert rule(("fc1", "bias"), b) == P("data")
+    odd = jax.ShapeDtypeStruct((10,), jnp.float32)  # 10 % 8 != 0
+    assert rule(("head", "bias"), odd) == P()
+    # base rule's axes are respected; data takes the largest FREE dim
+    base = tensor_parallel_rules("model")
+    rule2 = fsdp_sharding_rules("data", base=base, axis_size=8)
+    qkv = jax.ShapeDtypeStruct((256, 256), jnp.float32)
+    spec = rule2(("block0", "attn", "q", "kernel"), qkv)
+    assert spec == P("data", "model")
+
+
+def test_params_and_opt_state_are_sharded(mesh):
+    model = ForwardMLP()
+    opt = make_optimizer("adam", 1e-3)
+    eng = FSDP(model, opt, mesh)
+    ts = eng.create_state(seed_key(0))
+    w = ts.params["layer1"]["kernel"]  # [784, 512] → sharded 1/8 on dim 0
+    shard_shape = w.addressable_shards[0].data.shape
+    assert shard_shape[0] * WORLD == w.shape[0]
+    # Adam moments shard identically to their parameter.
+    m = ts.opt_state["m"]["layer1"]["kernel"]
+    assert m.sharding == w.sharding
+
+
+def test_fsdp_matches_dp_and_single_device(mesh, batch):
+    """The ZeRO-3 layout must be invisible to the math: FSDP == DP ==
+    single-device training on the same global batch, step for step."""
+    from tpudml.train import TrainState, make_train_step
+
+    images, labels = batch
+    model = ForwardMLP()
+
+    def run(engine_ctor, steps=4):
+        opt = make_optimizer("sgd", 0.05, momentum=0.9)
+        eng = engine_ctor(model, opt)
+        ts = eng.create_state(seed_key(1))
+        step = eng.make_train_step()
+        losses = []
+        for _ in range(steps):
+            ts, m = step(ts, images, labels)
+            losses.append(float(m["loss"]))
+        return losses, jax.device_get(ts.params)
+
+    fsdp_losses, fsdp_params = run(lambda m, o: FSDP(m, o, mesh))
+    dp_losses, dp_params = run(lambda m, o: DataParallel(m, o, mesh))
+
+    opt = make_optimizer("sgd", 0.05, momentum=0.9)
+    ts = jax.tree.map(lambda x: x, TrainState.create(model, opt, seed_key(1)))
+    step = make_train_step(model, opt)
+    single_losses = []
+    for _ in range(4):
+        ts, m = step(ts, images, labels)
+        single_losses.append(float(m["loss"]))
+
+    np.testing.assert_allclose(fsdp_losses, dp_losses, rtol=1e-4)
+    np.testing.assert_allclose(fsdp_losses, single_losses, rtol=1e-4)
+    for a, b in zip(jax.tree.leaves(fsdp_params), jax.tree.leaves(dp_params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6)
+
+
+def test_fsdp_composes_with_tp(batch):
+    """2-D {"data": 2, "model": 4} mesh: TP claims its dims, FSDP shards
+    the largest remaining free dim; training still matches single-device."""
+    from tpudml.models import TransformerLM
+    from tpudml.data.datasets import synthetic_lm
+    from tpudml.train import TrainState, make_train_step
+
+    mesh2 = make_mesh(MeshConfig({"data": 2, "model": 4}))
+    lm = TransformerLM(vocab_size=32, embed_dim=32, num_heads=4, num_layers=1,
+                       max_len=16)
+    # SGD for the param-parity oracle: Adam's early steps are ±sign-like
+    # (m/√v with v≈0), which amplifies benign float-reassociation noise
+    # from the sharded collectives far past any useful tolerance.
+    opt = make_optimizer("sgd", 0.1, momentum=0.9)
+    eng = FSDP(lm, opt, mesh2, base_rule=tensor_parallel_rules("model"))
+    ts = eng.create_state(seed_key(2))
+    step = eng.make_train_step()
+    seqs = jnp.asarray(synthetic_lm(8, 16, 32, seed=3))
+    x, y = seqs[:, :-1], seqs[:, 1:]
+
+    ref_ts = TrainState.create(lm, opt, seed_key(2))
+    ref_step = make_train_step(lm, opt)
+    for _ in range(3):
+        ts, m = step(ts, x, y)
+        ref_ts, rm = ref_step(ref_ts, x, y)
+        np.testing.assert_allclose(float(m["loss"]), float(rm["loss"]), rtol=2e-4)
+    for a, b in zip(jax.tree.leaves(ts.params), jax.tree.leaves(ref_ts.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=3e-3, atol=2e-5)
+
+
+def test_fsdp_memory_layout_scales(mesh):
+    """Total per-device parameter bytes ≈ 1/W of the model (replicated
+    remainder = small/odd leaves only)."""
+    model = ForwardMLP()
+    opt = make_optimizer("sgd", 0.05)
+    eng = FSDP(model, opt, mesh)
+    ts = eng.create_state(seed_key(0))
+    total = local = 0
+    for leaf in jax.tree.leaves(ts.params):
+        total += leaf.size
+        local += leaf.addressable_shards[0].data.size
+    assert local < total / (WORLD / 2)  # well under half; ~1/8 ideally

@@ -50,6 +50,7 @@ def test_dryrun_multichip_self_provisions():
         "ring-attention cp ok",
         "tensor-parallel ok",
         "expert-parallel ok",
+        "fsdp ok",
     ):
         assert regime in proc.stdout, f"missing regime '{regime}':\n{proc.stdout}"
 

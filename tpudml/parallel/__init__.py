@@ -18,6 +18,7 @@ from tpudml.parallel.sharding import (
 from tpudml.parallel.cp import ContextParallel, ring_attention, ulysses_attention
 from tpudml.parallel.dp import DataParallel, make_dp_train_step
 from tpudml.parallel.ep import ExpertParallel, expert_specs
+from tpudml.parallel.fsdp import FSDP, fsdp_sharding_rules
 from tpudml.parallel.mp import (
     GSPMDParallel,
     apply_rules,
@@ -31,6 +32,8 @@ __all__ = [
     "DataParallel",
     "ExpertParallel",
     "expert_specs",
+    "FSDP",
+    "fsdp_sharding_rules",
     "GPipe",
     "GSPMDParallel",
     "ring_attention",

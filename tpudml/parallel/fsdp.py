@@ -1,0 +1,128 @@
+"""Fully-sharded data parallelism (FSDP / ZeRO-3) over the ``data`` axis.
+
+The natural completion of the reference's parameter-server lineage: task4's
+``DistributedOptimizer`` updates parameters where they live (RRefs,
+reference: codes/task4/model.py:126); plain DP replicates everything and
+only shards the batch. FSDP shards the batch AND the parameters, gradients,
+and optimizer state over the SAME ``data`` axis — per-chip memory for
+params/grads/opt-state scales 1/W while the training math stays exactly DP.
+
+TPU-native design — this is deliberately NOT a hand-scheduled
+gather/scatter engine. Each parameter leaf is annotated with a
+PartitionSpec that shards its largest divisible dimension over ``data``
+(the "1-D parameter sharding" layout used by large JAX trainers), the
+batch is sharded over the same axis, and the XLA SPMD partitioner derives
+the ZeRO-3 schedule from the shardings alone:
+
+- forward/backward: each weight is **all-gathered on use** (and the
+  gather is scheduled/overlapped by XLA, then discarded — activations
+  never hold a full copy of every layer at once);
+- gradients: the batch-sharded loss makes each weight's gradient a
+  partial sum, which XLA materializes as **reduce-scatter** straight into
+  the 1/W gradient shard;
+- optimizer update: runs shard-local on the 1/W param + opt-state shards
+  (the update-where-params-live contract), no collective needed.
+
+Composes with tensor parallelism on a 2-D {"data": D, "model": M} mesh:
+pass ``base_rule=tensor_parallel_rules("model")`` and each leaf first takes
+its TP sharding, then FSDP shards the largest remaining free dimension
+over ``data`` — the standard 2-D layout (TP within, ZeRO across).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpudml.nn.layers import Module
+from tpudml.nn.losses import softmax_cross_entropy
+from tpudml.optim import Optimizer
+from tpudml.parallel.mp import GSPMDParallel, RuleFn
+
+
+def fsdp_sharding_rules(
+    axis_name: str = "data",
+    base: RuleFn | None = None,
+    axis_size: int | None = None,
+) -> RuleFn:
+    """ZeRO-3 parameter layout: shard each leaf's largest divisible free
+    dimension over ``axis_name``.
+
+    ``base`` (e.g. ``tensor_parallel_rules``) claims dimensions first; the
+    FSDP axis then takes the largest dimension the base left unsharded and
+    that ``axis_size`` divides (when known — the engine passes its mesh
+    axis size; without it, largest wins and ``apply_rules`` demotes
+    indivisible picks). Leaves with no shardable dimension (small biases,
+    odd shapes) stay replicated — correct, just not memory-scaled.
+    """
+
+    def rule(path: tuple, leaf) -> P:
+        spec = list(base(path, leaf)) if base is not None else []
+        spec += [None] * (leaf.ndim - len(spec))
+        free = [i for i in range(leaf.ndim) if spec[i] is None]
+        if axis_size:
+            free = [i for i in free if leaf.shape[i] % axis_size == 0]
+        # Largest qualifying dim; ties break toward the trailing dim
+        # (output features — keeps row-major shard strides contiguous).
+        best, best_size = None, 0
+        for i in free:
+            if leaf.shape[i] >= best_size:
+                best, best_size = i, leaf.shape[i]
+        if best is not None:
+            spec[best] = axis_name
+        while spec and spec[-1] is None:  # canonical form: no trailing Nones
+            spec.pop()
+        return P(*spec)
+
+    return rule
+
+
+class FSDP(GSPMDParallel):
+    """FSDP/ZeRO-3 training engine: one jitted GSPMD program per step.
+
+    Usage::
+
+        mesh = make_mesh(MeshConfig({"data": 8}))
+        eng = FSDP(model, opt, mesh)
+        ts = eng.create_state(key)        # params/opt-state 1/8 per chip
+        step = eng.make_train_step()      # (ts, x, labels) -> (ts, metrics)
+
+    2-D composition with tensor parallelism::
+
+        mesh = make_mesh(MeshConfig({"data": 2, "model": 4}))
+        eng = FSDP(model, opt, mesh,
+                   base_rule=tensor_parallel_rules("model"))
+
+    Parity oracle (tests): FSDP over W shards matches replicated DP and
+    single-device training step for step — the sharding changes where
+    bytes live, never the math.
+    """
+
+    def __init__(
+        self,
+        model: Module,
+        optimizer: Optimizer,
+        mesh: Mesh,
+        axis_name: str = "data",
+        base_rule: RuleFn | None = None,
+        rng_root: jax.Array | None = None,
+        accum_steps: int = 1,
+        loss: Callable = softmax_cross_entropy,
+        aux_loss_weight: float | None = None,
+    ):
+        super().__init__(
+            model,
+            optimizer,
+            mesh,
+            rule=fsdp_sharding_rules(
+                axis_name, base_rule, axis_size=mesh.shape[axis_name]
+            ),
+            axis_name=axis_name,
+            batch_axis=axis_name,
+            rng_root=rng_root,
+            accum_steps=accum_steps,
+            loss=loss,
+            aux_loss_weight=aux_loss_weight,
+        )
