@@ -73,7 +73,10 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--num_layers", type=int, default=2)
     p.add_argument("--num_kv_heads", type=int, default=None, help="GQA/MQA")
     p.add_argument("--rope", action="store_true", help="rotary positions")
-    p.add_argument("--remat", action="store_true", help="remat ring ticks")
+    p.add_argument(
+        "--remat", action="store_true",
+        help="accepted for compatibility (ring backward always recomputes)",
+    )
     p.add_argument("--moe_experts", type=int, default=0, help="Switch MoE FFN")
     p.add_argument("--dropout", type=float, default=0.0)
     p.add_argument("--steps", type=int, default=60)

@@ -89,7 +89,7 @@ def test_fsdp_matches_dp_and_single_device(mesh, batch):
     dp_losses, dp_params = run(lambda m, o: DataParallel(m, o, mesh))
 
     opt = make_optimizer("sgd", 0.05, momentum=0.9)
-    ts = jax.tree.map(lambda x: x, TrainState.create(model, opt, seed_key(1)))
+    ts = TrainState.create(model, opt, seed_key(1))
     step = make_train_step(model, opt)
     single_losses = []
     for _ in range(4):

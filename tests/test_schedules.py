@@ -137,7 +137,12 @@ def test_remat_reachable_from_model():
     )
 
 
-def test_ring_attention_remat_matches():
+def test_ring_attention_remat_flag_compat():
+    """``remat=`` is accepted for API compatibility only: the ring
+    custom-VJP backward always recomputes per block (flash-style), so the
+    flag is implied. This pins that passing it still works, matches full
+    attention, and differentiates (gradient parity of the backward itself
+    lives in tests/test_cp.py::test_ring_grads_match_full)."""
     from tpudml.nn.attention import dot_product_attention
     from tpudml.parallel.cp import ring_attention
     from tpudml.parallel.sharding import shard_map_fn
@@ -150,15 +155,13 @@ def test_ring_attention_remat_matches():
     )
     spec = P(None, "seq")
 
-    def loss(q, k, v, remat):
+    def loss(q, k, v):
         fn = shard_map_fn(
-            lambda q, k, v: ring_attention(q, k, v, "seq", causal=True, remat=remat),
+            lambda q, k, v: ring_attention(q, k, v, "seq", causal=True, remat=True),
             mesh, in_specs=(spec, spec, spec), out_specs=spec,
         )
         return jnp.sum(fn(q, k, v) ** 2)
 
-    g_plain = jax.grad(lambda q: loss(q, k, v, False))(q)
-    g_remat = jax.grad(lambda q: loss(q, k, v, True))(q)
-    np.testing.assert_allclose(np.asarray(g_plain), np.asarray(g_remat), rtol=1e-5)
     want = jnp.sum(dot_product_attention(q, k, v, causal=True) ** 2)
-    np.testing.assert_allclose(float(loss(q, k, v, True)), float(want), rtol=1e-5)
+    np.testing.assert_allclose(float(loss(q, k, v)), float(want), rtol=1e-5)
+    assert np.isfinite(np.asarray(jax.grad(lambda q: loss(q, k, v))(q))).all()

@@ -89,7 +89,9 @@ class MultiHeadAttention(Module):
     causal: bool = False
     impl: str = "full"
     axis_name: str = "seq"
-    remat: bool = False  # ring impl: rematerialize ticks in backward
+    # Accepted for API compatibility; the ring custom-VJP backward always
+    # recomputes per-block (flash-style), so rematerialization is implied.
+    remat: bool = False
     num_kv_heads: int | None = None  # GQA/MQA: K/V head groups (< num_heads)
     rope: bool = False  # rotary position embeddings on q/k
     rope_base: float = 10000.0

@@ -6,6 +6,10 @@ produce optimally are written in Pallas against the TPU memory hierarchy
 (HBM→VMEM→MXU; /opt/skills/guides/pallas_guide.md is the playbook).
 """
 
-from tpudml.ops.attention_kernel import flash_attention
+from tpudml.ops.attention_kernel import (
+    flash_attention,
+    flash_block_grads,
+    flash_forward_lse,
+)
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "flash_block_grads", "flash_forward_lse"]

@@ -255,7 +255,6 @@ def _dkdv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_backward(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
     b, t, h, d = q.shape
-    scale = 1.0 / (d ** 0.5)
     block_q, block_k, t_pad_q, t_pad_k = _plan(t, block_q, block_k)
     qf, dof, of = _fold_pad((q, g, o), b, h, t, d, t_pad_q)
     kf, vf = _fold_pad((k, v), b, h, t, d, t_pad_k)
@@ -263,7 +262,18 @@ def _flash_backward(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
     delta = jnp.sum(
         dof.astype(jnp.float32) * of.astype(jnp.float32), axis=-1, keepdims=True
     )  # [B·H, t_pad_q, 1]
+    dqf, dkf, dvf = _backward_calls(
+        qf, kf, vf, dof, lse, delta, b, h, t, d, causal, block_q, block_k,
+        t_pad_q, t_pad_k, interpret,
+    )
+    return tuple(_unfold(x, b, h, t, d) for x in (dqf, dkf, dvf))
 
+
+def _backward_calls(qf, kf, vf, dof, lse, delta, b, h, t, d, causal, block_q,
+                    block_k, t_pad_q, t_pad_k, interpret):
+    """The two backward pallas_calls on pre-folded [B·H, t_pad, ·] inputs
+    (shared by the full backward and the per-block ring entry point)."""
+    scale = 1.0 / (d ** 0.5)
     bh = b * h
     nq, nk = t_pad_q // block_q, t_pad_k // block_k
     q_spec = pl.BlockSpec((1, block_q, d), lambda i, j, r: (i, j, 0))
@@ -274,7 +284,7 @@ def _flash_backward(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
             _dq_kernel, scale=scale, causal=causal, block_q=block_q,
             block_k=block_k, t_valid=t,
         ),
-        out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
+        out_shape=jax.ShapeDtypeStruct(qf.shape, qf.dtype),
         grid=(bh, nq, nk),
         in_specs=[
             q_spec,
@@ -298,8 +308,8 @@ def _flash_backward(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
             block_k=block_k, t_valid=t, nk=nk,
         ),
         out_shape=[
-            jax.ShapeDtypeStruct(kf.shape, k.dtype),
-            jax.ShapeDtypeStruct(vf.shape, v.dtype),
+            jax.ShapeDtypeStruct(kf.shape, kf.dtype),
+            jax.ShapeDtypeStruct(vf.shape, vf.dtype),
         ],
         grid=(bh, nk, nq),
         in_specs=[k_spec, k_spec, qrow_spec, qrow_spec, lrow_spec, lrow_spec],
@@ -311,6 +321,80 @@ def _flash_backward(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
         interpret=interpret,
     )(kf, vf, qf, dof, lse, delta)
 
+    return dqf, dkf, dvf
+
+
+# ------------------------------------------------- blockwise entry points
+#
+# Ring context parallelism (tpudml.parallel.cp) composes attention from
+# per-K/V-block partials: each arriving block runs a flash forward that
+# also RETURNS its log-sum-exp so blocks merge exactly, and the ring
+# backward re-runs the tile kernels per block with the GLOBALLY-merged
+# softmax statistics (lse, Δ) — the flash decomposition dq = Σ_b ds_b·K_b,
+# dk_b = ds_bᵀ·Q with p_b = exp(s_b − lse_global).
+
+
+def _fold_rows(x, t_pad):
+    """[B, H, T] → [B·H, t_pad, 1] (row-statistic layout of the kernels)."""
+    b, h, t = x.shape
+    f = x.reshape(b * h, t, 1)
+    if t_pad != t:
+        f = jnp.pad(f, ((0, 0), (0, t_pad - t), (0, 0)))
+    return f
+
+
+def flash_forward_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 512,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """Flash forward that also returns the row log-sum-exp.
+
+    Returns (out [B,T,H,D], lse [B,H,T] f32). ``causal`` here masks by
+    LOCAL tile positions — for a ring block pair this is exactly the
+    diagonal (same-length, aligned) block; off-diagonal visible blocks
+    pass causal=False.
+    """
+    b, t, h, _ = q.shape
+    out, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return out, lse[:, :t, 0].reshape(b, h, t)
+
+
+def flash_block_grads(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    do: jax.Array,
+    lse: jax.Array,
+    delta: jax.Array,
+    *,
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 512,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Per-block flash backward with EXTERNAL softmax statistics.
+
+    ``lse``/``delta`` [B,H,T] come from the globally-merged attention
+    (delta = rowsum(dO ⊙ O_final)), so the returned (dq, dk, dv) are this
+    block's exact contributions to the global gradients; summing over
+    blocks reproduces the full backward.
+    """
+    b, t, h, d = q.shape
+    block_q, block_k, t_pad_q, t_pad_k = _plan(t, block_q, block_k)
+    qf, dof = _fold_pad((q, do), b, h, t, d, t_pad_q)
+    kf, vf = _fold_pad((k, v), b, h, t, d, t_pad_k)
+    lsef = _fold_rows(lse.astype(jnp.float32), t_pad_q)
+    deltaf = _fold_rows(delta.astype(jnp.float32), t_pad_q)
+    dqf, dkf, dvf = _backward_calls(
+        qf, kf, vf, dof, lsef, deltaf, b, h, t, d, causal, block_q, block_k,
+        t_pad_q, t_pad_k, interpret,
+    )
     return tuple(_unfold(x, b, h, t, d) for x in (dqf, dkf, dvf))
 
 

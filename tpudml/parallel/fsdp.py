@@ -64,11 +64,12 @@ def fsdp_sharding_rules(
         free = [i for i in range(leaf.ndim) if spec[i] is None]
         if axis_size:
             free = [i for i in free if leaf.shape[i] % axis_size == 0]
-        # Largest qualifying dim; ties break toward the trailing dim
-        # (output features — keeps row-major shard strides contiguous).
+        # Largest qualifying dim; ties break toward the LEADING dim —
+        # splitting the outermost axis of a C-order array gives contiguous
+        # shards, so the all_gather on use is a plain concat.
         best, best_size = None, 0
         for i in free:
-            if leaf.shape[i] >= best_size:
+            if leaf.shape[i] > best_size:
                 best, best_size = i, leaf.shape[i]
         if best is not None:
             spec[best] = axis_name
@@ -112,6 +113,10 @@ class FSDP(GSPMDParallel):
         loss: Callable = softmax_cross_entropy,
         aux_loss_weight: float | None = None,
     ):
+        if axis_name not in mesh.shape:
+            raise ValueError(
+                f"FSDP axis {axis_name!r} not in mesh axes {tuple(mesh.shape)}"
+            )
         super().__init__(
             model,
             optimizer,
