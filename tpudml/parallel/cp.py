@@ -40,7 +40,7 @@ from tpudml.nn.losses import accuracy, softmax_cross_entropy
 from tpudml.optim import Optimizer
 from tpudml.parallel.sharding import (
     make_counting_eval_step,
-    serialize_dispatch,
+    DispatchThrottle,
     shard_map_fn,
 )
 from tpudml.train import (
@@ -347,7 +347,7 @@ class ContextParallel:
             )
         self.batch_axis = batch_axis
         self.world = mesh.shape[axis_name]
-        self._sync_each_step = serialize_dispatch(mesh)
+        self._throttle = DispatchThrottle(mesh)
         self._eval_step = None
 
     def create_state(self, key: jax.Array) -> TrainState:
@@ -441,8 +441,7 @@ class ContextParallel:
 
         def step(ts: TrainState, tokens, labels):
             out = jitted(ts, jnp.asarray(tokens), jnp.asarray(labels))
-            if self._sync_each_step:
-                jax.block_until_ready(out[1]["loss"])
+            self._throttle.after_step(out[1]["loss"])
             return out
 
         return step

@@ -36,7 +36,7 @@ from tpudml.optim import Optimizer
 from tpudml.parallel.sharding import (
     data_sharding,
     replicate,
-    serialize_dispatch,
+    DispatchThrottle,
     shard_map_fn,
 )
 from tpudml.train import (
@@ -100,7 +100,7 @@ class DataParallel:
         self._loss_fn = make_loss_fn(
             model, loss, resolve_aux_loss_weight(model, aux_loss_weight)
         )
-        self._sync_each_step = serialize_dispatch(mesh)
+        self._throttle = DispatchThrottle(mesh)
 
     # ---------------------------------------------------------------- state
 
@@ -224,8 +224,7 @@ class DataParallel:
         def step(ts: TrainState, images, labels):
             images, labels = self.shard_batch(images, labels)
             out = jitted(ts, images, labels)
-            if self._sync_each_step:
-                jax.block_until_ready(out[1]["loss"])
+            self._throttle.after_step(out[1]["loss"])
             return out
 
         return step

@@ -31,7 +31,7 @@ from tpudml.nn.losses import accuracy
 from tpudml.optim import Optimizer, shard_aware_clip
 from tpudml.parallel.sharding import (
     make_counting_eval_step,
-    serialize_dispatch,
+    DispatchThrottle,
     shard_map_fn,
 )
 from tpudml.train import TrainState, evaluate_counts, make_loss_fn
@@ -86,7 +86,7 @@ class ExpertParallel:
         # Switch load-balancing pressure on by default for MoE training
         # (the canonical α≈0.01); pass 0.0 to disable.
         self._loss_fn = make_loss_fn(model, aux_loss_weight=aux_loss_weight)
-        self._sync_each_step = serialize_dispatch(mesh)
+        self._throttle = DispatchThrottle(mesh)
         self._eval_step = None
         # Specs derive from the model structure alone (eval_shape — no
         # compute), so step functions can be built before/without
@@ -194,8 +194,7 @@ class ExpertParallel:
 
         def step(ts: TrainState, x, labels):
             out = jitted(ts, jnp.asarray(x), jnp.asarray(labels))
-            if self._sync_each_step:
-                jax.block_until_ready(out[1]["loss"])
+            self._throttle.after_step(out[1]["loss"])
             return out
 
         return step

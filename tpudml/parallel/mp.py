@@ -41,7 +41,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from tpudml.nn.layers import Module
 from tpudml.nn.losses import softmax_cross_entropy
 from tpudml.optim import Optimizer
-from tpudml.parallel.sharding import serialize_dispatch
+from tpudml.parallel.sharding import DispatchThrottle
 from tpudml.train import (
     TrainState,
     accumulate_grads,
@@ -206,7 +206,7 @@ class GSPMDParallel:
             model, loss, resolve_aux_loss_weight(model, aux_loss_weight)
         )
         self._specs = None  # computed at create_state
-        self._sync_each_step = serialize_dispatch(mesh)
+        self._throttle = DispatchThrottle(mesh)
 
     # ---------------------------------------------------------------- state
 
@@ -278,8 +278,7 @@ class GSPMDParallel:
             images = jax.device_put(jnp.asarray(images), batch_sharding)
             labels = jax.device_put(jnp.asarray(labels), batch_sharding)
             out = jitted(ts, images, labels)
-            if self._sync_each_step:
-                jax.block_until_ready(out[1]["loss"])
+            self._throttle.after_step(out[1]["loss"])
             return out
 
         return step

@@ -37,7 +37,7 @@ from tpudml.comm.collectives import psum_tree
 from tpudml.nn.layers import Module
 from tpudml.nn.losses import accuracy, softmax_cross_entropy
 from tpudml.optim import Optimizer, shard_aware_clip
-from tpudml.parallel.sharding import serialize_dispatch, shard_map_fn
+from tpudml.parallel.sharding import DispatchThrottle, shard_map_fn
 from tpudml.train import TrainState
 
 PyTree = Any
@@ -130,7 +130,7 @@ class GPipe:
         self.prologue = prologue
         self.epilogue = epilogue
         self.loss = loss
-        self._sync_each_step = serialize_dispatch(mesh)
+        self._throttle = DispatchThrottle(mesh)
 
     # ---------------------------------------------------------------- params
 
@@ -293,8 +293,7 @@ class GPipe:
 
         def step(ts: TrainState, x, labels):
             out = jitted(ts, jnp.asarray(x), jnp.asarray(labels))
-            if self._sync_each_step:
-                jax.block_until_ready(out[1]["loss"])
+            self._throttle.after_step(out[1]["loss"])
             return out
 
         return step

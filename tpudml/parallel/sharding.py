@@ -34,14 +34,40 @@ def shard_map_fn(fn, mesh: Mesh, in_specs, out_specs, check_rep: bool = False):
 
 
 def serialize_dispatch(mesh: Mesh) -> bool:
-    """Whether engines should block on each step's output before dispatching
-    the next. XLA:CPU's collective rendezvous deadlocks (and then aborts the
-    process) when many in-flight partitioned programs oversubscribe the host
-    thread pool — seen with >~50 async-queued steps on a 1-core box. The
-    simulated-CPU mesh therefore serializes dispatch; real TPU keeps full
-    async pipelining.
-    """
+    """Whether a mesh needs dispatch throttling at all. XLA:CPU's
+    collective rendezvous deadlocks (and then aborts the process) when many
+    in-flight partitioned programs oversubscribe the host thread pool —
+    seen with >~50 async-queued steps on a 1-core box. Real TPU keeps full
+    async pipelining."""
     return all(d.platform == "cpu" for d in mesh.devices.flat)
+
+
+class DispatchThrottle:
+    """Bound the number of in-flight dispatched steps on CPU meshes.
+
+    Full per-step serialization (round 1's workaround) hid the real TPU
+    execution mode from every simulated run: nothing ever had more than
+    one step in flight, so async multi-step pipelining went untested.
+    Instead, keep a window of ``max_in_flight`` un-materialized step
+    outputs and block only on the OLDEST once the window fills — the
+    simulated mesh now genuinely overlaps dispatch (window > 1) while the
+    rendezvous pool stays bounded. On non-CPU meshes this is a no-op.
+    """
+
+    def __init__(self, mesh: Mesh, max_in_flight: int = 8):
+        self.enabled = serialize_dispatch(mesh)
+        self.max_in_flight = max_in_flight
+        self._pending: list = []
+        self.max_pending_seen = 0  # observability (asserted in tests)
+
+    def after_step(self, out_leaf) -> None:
+        """Call with one device value from each dispatched step."""
+        if not self.enabled:
+            return
+        self._pending.append(out_leaf)
+        self.max_pending_seen = max(self.max_pending_seen, len(self._pending))
+        if len(self._pending) >= self.max_in_flight:
+            jax.block_until_ready(self._pending.pop(0))
 
 
 def make_counting_eval_step(model, mesh: Mesh, in_specs, axes):
