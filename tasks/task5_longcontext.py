@@ -16,9 +16,12 @@ next-token data with a selectable parallelism/attention strategy:
                          all-to-all variant);
 - ``--parallel tp``      Megatron-style tensor parallelism via GSPMD rules
                          over a {"model": N} mesh;
-- ``--parallel pp``      micro-batched pipeline (GPipe) — one decoder block
-                         per stage over a {"stage": N} mesh (depth = N;
+- ``--parallel pp``      micro-batched pipeline — one decoder block per
+                         stage over a {"stage": N} mesh (depth = N;
                          ``--num_layers`` is ignored in this mode);
+                         ``--schedule gpipe`` (scan+AD) or ``1f1b``
+                         (interleaved backwards: S-bounded activation
+                         memory, dropout-capable);
 - ``--parallel ep``      expert parallelism — requires ``--moe_experts N``;
                          the Switch-MoE FFN's experts shard over an
                          {"expert": N} mesh with all_to_all dispatch.
@@ -62,6 +65,11 @@ def parse_args(argv=None) -> argparse.Namespace:
         default="single",
     )
     p.add_argument("--microbatches", type=int, default=4, help="pp micro-batches")
+    p.add_argument(
+        "--schedule", choices=["gpipe", "1f1b"], default="gpipe",
+        help="pp schedule: gpipe (scan+AD) or 1f1b (interleaved, S-bounded "
+        "activation memory, dropout-capable)",
+    )
     p.add_argument("--attn", choices=["full", "flash", "ring", "ulysses"], default=None,
                    help="attention impl; defaults: single/dp/tp=full, cp=ring")
     p.add_argument("--n_devices", type=int, default=None)
@@ -153,20 +161,19 @@ def build_engine(args, devices):
     if args.parallel == "pp":
         # One decoder block per pipeline stage; embed/head replicated.
         # Model knobs carry over; MoE blocks are stateful (aux-loss slot)
-        # and the pipeline requires stateless blocks.
+        # and the pipeline requires stateless blocks. --schedule gpipe is
+        # the all-forward-then-AD-backward scan; --schedule 1f1b
+        # interleaves backwards (S in-flight activations instead of M)
+        # and supports --dropout via per-(stage, micro) rng keys.
         if args.moe_experts:
             raise ValueError("--parallel pp does not support --moe_experts")
-        if args.dropout:
-            raise ValueError("--parallel pp does not support --dropout")
+        if args.dropout and args.schedule != "1f1b":
+            raise ValueError("--dropout pipelines need --schedule 1f1b")
         from tpudml.models import TransformerBlock, TransformerEmbed, TransformerHead
-        from tpudml.parallel.pp import GPipe
+        from tpudml.parallel.pp import GPipe, OneFOneB
 
         mesh = make_mesh(MeshConfig({"stage": n}), devices)
-        pipe = GPipe(
-            TransformerBlock(
-                args.embed_dim, args.num_heads, causal=True, impl=impl,
-                num_kv_heads=args.num_kv_heads, rope=args.rope,
-            ),
+        common = dict(
             n_microbatches=args.microbatches,
             mesh=mesh,
             optimizer=opt,
@@ -176,6 +183,15 @@ def build_engine(args, devices):
             ),
             epilogue=TransformerHead(args.embed_dim, args.vocab),
         )
+        block = TransformerBlock(
+            args.embed_dim, args.num_heads, causal=True, impl=impl,
+            num_kv_heads=args.num_kv_heads, rope=args.rope,
+            dropout=args.dropout,
+        )
+        if args.schedule == "1f1b":
+            pipe = OneFOneB(block, rng_root=rng_root, **common)
+        else:
+            pipe = GPipe(block, **common)
         return pipe.create_state(seed_key(args.seed)), pipe.make_train_step()
     # tp
     mesh = make_mesh(MeshConfig({"model": n}), devices)

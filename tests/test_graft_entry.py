@@ -51,6 +51,7 @@ def test_dryrun_multichip_self_provisions():
         "tensor-parallel ok",
         "expert-parallel ok",
         "fsdp ok",
+        "1f1b pipeline ok",
     ):
         assert regime in proc.stdout, f"missing regime '{regime}':\n{proc.stdout}"
 

@@ -33,7 +33,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tpudml.comm.collectives import psum_tree
+from tpudml.comm.collectives import ppermute_ring, psum_tree
 from tpudml.nn.layers import Module
 from tpudml.nn.losses import accuracy, softmax_cross_entropy
 from tpudml.optim import Optimizer, shard_aware_clip
@@ -67,6 +67,28 @@ def _grad_scale_bwd(c, g):
 
 
 _grad_scale.defvjp(_grad_scale_fwd, _grad_scale_bwd)
+
+
+def _has_dropout(module) -> bool:
+    """Recursively detect dropout in a Module tree (a ``dropout`` field or
+    a nested ``Dropout`` layer, e.g. inside a Sequential)."""
+    import dataclasses
+
+    from tpudml.nn.layers import Dropout
+
+    def scan(obj) -> bool:
+        # rate-0 Dropout is the identity — not "active" dropout.
+        if isinstance(obj, Dropout):
+            return bool(getattr(obj, "rate", 0.0))
+        if getattr(obj, "dropout", 0.0):
+            return True
+        if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+            return any(scan(getattr(obj, f.name)) for f in dataclasses.fields(obj))
+        if isinstance(obj, (tuple, list)):
+            return any(scan(o) for o in obj)
+        return False
+
+    return scan(module)
 
 
 def _spec_shardings(spec_tree: PyTree, mesh: Mesh) -> PyTree:
@@ -134,17 +156,24 @@ class GPipe:
 
     # ---------------------------------------------------------------- params
 
+    def _validate_block(self, states) -> None:
+        if jax.tree.leaves(states):
+            raise ValueError("pipeline blocks must be stateless (no BatchNorm)")
+        if _has_dropout(self.block):
+            # The GPipe schedule runs blocks in inference mode (no
+            # train/rng threading through the scan); silent no-op dropout
+            # would fake regularization, so reject it loudly. The 1F1B
+            # engine threads per-(stage, micro) rng keys and lifts this.
+            raise ValueError(
+                "GPipe stages do not support dropout; use OneFOneB "
+                "(schedule='1f1b') with rng_root for dropout pipelines"
+            )
+
     def init_params(self, key: jax.Array) -> PyTree:
         kp, kb, ke = jax.random.split(key, 3)
         stage_keys = jax.random.split(kb, self.n_stages)
         stacked, states = jax.vmap(self.block.init)(stage_keys)
-        if jax.tree.leaves(states):
-            raise ValueError("pipeline blocks must be stateless (no BatchNorm)")
-        if getattr(self.block, "dropout", 0.0):
-            # The schedule runs blocks in inference mode (no train/rng
-            # threading through the scan); silent no-op dropout would fake
-            # regularization, so reject it loudly.
-            raise ValueError("pipeline stages do not support dropout")
+        self._validate_block(states)
         pro = self.prologue.init(kp)[0] if self.prologue is not None else {}
         epi = self.epilogue.init(ke)[0] if self.epilogue is not None else {}
         return {"prologue": pro, "stages": stacked, "epilogue": epi}
@@ -245,34 +274,36 @@ class GPipe:
 
     # ------------------------------------------------------------ train step
 
+    def _spmd_step(self, ts: TrainState, x, labels):
+        """Per-device train-step body (under shard_map); the 1F1B subclass
+        replaces this with its interleaved schedule."""
+        axis = self.axis_name
+
+        def loss_fn(params):
+            logits = self._pipe_body(params, x)
+            return self.loss(logits, labels), logits
+
+        (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            ts.params
+        )
+        # Prologue cotangents exist only on stage 0 (only its prologue
+        # output feeds the pipeline); psum replicates the true gradient.
+        # Epilogue gradients are computed identically on every device
+        # (replicated input, replicated params) — no collective needed.
+        grads = dict(grads, prologue=psum_tree(grads["prologue"], axis))
+        new_params, new_opt = self.optimizer.update(grads, ts.opt_state, ts.params)
+        metrics = {"loss": loss, "accuracy": accuracy(logits, labels)}
+        new_ts = TrainState(
+            params=new_params,
+            model_state=ts.model_state,
+            opt_state=new_opt,
+            step=ts.step + 1,
+        )
+        return new_ts, metrics
+
     def make_train_step(self) -> Callable:
         if self.optimizer is None:
             raise ValueError("make_train_step needs an optimizer")
-        axis = self.axis_name
-
-        def spmd(ts: TrainState, x, labels):
-            def loss_fn(params):
-                logits = self._pipe_body(params, x)
-                return self.loss(logits, labels), logits
-
-            (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                ts.params
-            )
-            # Prologue cotangents exist only on stage 0 (only its prologue
-            # output feeds the pipeline); psum replicates the true gradient.
-            # Epilogue gradients are computed identically on every device
-            # (replicated input, replicated params) — no collective needed.
-            grads = dict(grads, prologue=psum_tree(grads["prologue"], axis))
-            new_params, new_opt = self.optimizer.update(grads, ts.opt_state, ts.params)
-            metrics = {"loss": loss, "accuracy": accuracy(logits, labels)}
-            new_ts = TrainState(
-                params=new_params,
-                model_state=ts.model_state,
-                opt_state=new_opt,
-                step=ts.step + 1,
-            )
-            return new_ts, metrics
-
         specs = TrainState(
             params=self.param_specs(),
             model_state=P(),
@@ -283,7 +314,7 @@ class GPipe:
         # Input state is CONSUMED; callers must rebind ts every step.
         jitted = jax.jit(
             shard_map_fn(
-                spmd,
+                self._spmd_step,
                 self.mesh,
                 in_specs=(specs, P(), P()),
                 out_specs=(specs, P()),
@@ -313,3 +344,205 @@ class GPipe:
         if self.epilogue is not None:
             h = self.epilogue(params["epilogue"], h)
         return h
+
+
+class OneFOneB(GPipe):
+    """1F1B (one-forward-one-backward) pipeline schedule.
+
+    GPipe's scan schedule holds ALL M micro-batch activations in flight
+    (the scan's AD residuals); 1F1B interleaves each stage's backward
+    between forwards so at most S activations are ever live per stage —
+    the standard deep-pipeline memory schedule (Megatron/DeepSpeed
+    lineage), here as one lockstep SPMD program:
+
+    - tick t, stage s: forward of micro m at t = s + 2m, backward of
+      micro m at t = 2S − s − 1 + 2m. The two never collide on a stage,
+      every dependency arrives exactly one ppermute hop earlier, and slot
+      reuse m mod S is safe because bwd(s, m) always completes before
+      fwd(s, m+S).
+    - backwards are hand-rolled per-stage ``jax.vjp`` calls that
+      RECOMPUTE the stage forward from the saved input (flash-style
+      remat): the only live state is the S-slot input buffer + carried
+      gradient accumulators, so scan-AD residual growth with M is gone.
+    - the last stage fuses its forward with loss + epilogue inside its
+      backward tick (cotangent seeded 1/M), so its forward tick only
+      banks the input.
+    - dropout IS supported (GPipe's restriction lifted): per-(stage,
+      micro) keys fold ``rng_root``/step/stage/micro, and the backward's
+      recompute folds the SAME key, so gradients are exact for the
+      dropout-applied function. Stateless blocks only, as in GPipe.
+
+    Lockstep trade: each tick runs either a forward (1×) or a backward
+    (~2× + recompute) unit, so tick latency is the slowest stage's unit;
+    utilization matches GPipe's bubble fraction while peak activation
+    memory drops from M to S slots — the property asserted by the
+    compiled memory-analysis test.
+    """
+
+    def _validate_block(self, states) -> None:
+        if jax.tree.leaves(states):
+            raise ValueError("pipeline blocks must be stateless (no BatchNorm)")
+        if _has_dropout(self.block) and self.rng_root is None:
+            raise ValueError("dropout pipeline stages need rng_root")
+
+    def __init__(self, *args, rng_root: jax.Array | None = None, **kwargs):
+        self.rng_root = rng_root  # before super(): _validate_block reads it
+        super().__init__(*args, **kwargs)
+
+    # ------------------------------------------------------------- schedule
+
+    def _spmd_step(self, ts: TrainState, x, labels):
+        axis, S, M = self.axis_name, self.n_stages, self.n_microbatches
+        stage = lax.axis_index(axis)
+        train = self.rng_root is not None
+        step_key = (
+            jax.random.fold_in(self.rng_root, ts.step) if train else None
+        )
+
+        local = jax.tree.map(lambda p: p[0], ts.params["stages"])
+        p_pro, p_epi = ts.params["prologue"], ts.params["epilogue"]
+
+        batch = x.shape[0]
+        if batch % M:
+            raise ValueError(f"batch {batch} not divisible by {M} microbatches")
+        mb = x.reshape(M, batch // M, *x.shape[1:])
+        mb_labels = labels.reshape(M, batch // M, *labels.shape[1:])
+
+        def run_pro(xm):
+            return self.prologue(p_pro, xm) if self.prologue is not None else xm
+
+        def key_for(m):
+            if step_key is None:
+                return None
+            return jax.random.fold_in(jax.random.fold_in(step_key, stage), m)
+
+        def run_block(p, xin, key):
+            return self.block.apply(p, {}, xin, train=train, rng=key)[0]
+
+        act_template = jax.eval_shape(run_pro, jax.ShapeDtypeStruct(
+            mb.shape[1:], mb.dtype
+        ))
+        zeros_act = jnp.zeros(act_template.shape, act_template.dtype)
+        zeros_stage = jax.tree.map(jnp.zeros_like, local)
+        zeros_pro = jax.tree.map(jnp.zeros_like, p_pro)
+        zeros_epi = jax.tree.map(jnp.zeros_like, p_epi)
+
+        def tick(carry, t):
+            act_buf, fwd_recv, bwd_recv, g_st, g_pro, g_epi, loss_sum, acc_sum = carry
+
+            # ---------------------------------------------- forward unit
+            tf = t - stage
+            valid_f = (tf >= 0) & (tf % 2 == 0) & (tf < 2 * M)
+            m_f = jnp.clip(tf // 2, 0, M - 1)
+            xm_f = lax.dynamic_index_in_dim(mb, m_f, keepdims=False)
+            x_in = jnp.where(stage == 0, run_pro(xm_f), fwd_recv)
+            act_buf = lax.cond(
+                valid_f,
+                lambda b: lax.dynamic_update_index_in_dim(b, x_in, m_f % S, 0),
+                lambda b: b,
+                act_buf,
+            )
+            # Last stage's forward fuses into its backward tick — its
+            # forward unit only banks the input above.
+            y = lax.cond(
+                valid_f & (stage < S - 1),
+                lambda: run_block(local, x_in, key_for(m_f)),
+                lambda: zeros_act,
+            )
+            fwd_send = ppermute_ring(y, axis, 1)
+
+            # --------------------------------------------- backward unit
+            tb = t - (2 * S - stage - 1)
+            valid_b = (tb >= 0) & (tb % 2 == 0) & (tb < 2 * M)
+            m_b = jnp.clip(tb // 2, 0, M - 1)
+            x_saved = lax.dynamic_index_in_dim(act_buf, m_b % S, keepdims=False)
+            ym_b = lax.dynamic_index_in_dim(mb_labels, m_b, keepdims=False)
+            xm_b = lax.dynamic_index_in_dim(mb, m_b, keepdims=False)
+            key_b = key_for(m_b)
+
+            def last_bwd():
+                def f(p_st, p_ep, xin):
+                    h = run_block(p_st, xin, key_b)
+                    logits = (
+                        self.epilogue(p_ep, h) if self.epilogue is not None else h
+                    )
+                    return self.loss(logits, ym_b), logits
+
+                loss_m, pull, logits = jax.vjp(f, local, p_epi, x_saved,
+                                               has_aux=True)
+                d_st, d_ep, dx = pull(jnp.asarray(1.0 / M, loss_m.dtype))
+                return d_st, d_ep, dx, loss_m, accuracy(logits, ym_b)
+
+            def mid_bwd():
+                _, pull = jax.vjp(
+                    lambda p_st, xin: run_block(p_st, xin, key_b), local, x_saved
+                )
+                d_st, dx = pull(bwd_recv)
+                return d_st, zeros_epi, dx, jnp.zeros(()), jnp.zeros(())
+
+            def bwd_unit():
+                d_st, d_ep, dx, loss_m, acc_m = lax.cond(
+                    stage == S - 1, last_bwd, mid_bwd
+                )
+                # Stage 0 consumes its own dx through the prologue.
+                def pro_bwd():
+                    _, pull = jax.vjp(lambda p: run_pro_p(p, xm_b), p_pro)
+                    return pull(dx)[0]
+
+                def run_pro_p(p, xm):
+                    return self.prologue(p, xm) if self.prologue is not None else xm
+
+                d_pro = lax.cond(stage == 0, pro_bwd, lambda: zeros_pro)
+                return d_st, d_pro, d_ep, dx, loss_m, acc_m
+
+            d_st, d_pro, d_ep, dx, loss_m, acc_m = lax.cond(
+                valid_b,
+                bwd_unit,
+                lambda: (zeros_stage, zeros_pro, zeros_epi, zeros_act,
+                         jnp.zeros(()), jnp.zeros(())),
+            )
+            bwd_send = ppermute_ring(dx, axis, -1)
+
+            g_st = jax.tree.map(jnp.add, g_st, d_st)
+            g_pro = jax.tree.map(jnp.add, g_pro, d_pro)
+            g_epi = jax.tree.map(jnp.add, g_epi, d_ep)
+            new_carry = (
+                act_buf, fwd_send, bwd_send, g_st, g_pro, g_epi,
+                loss_sum + loss_m, acc_sum + acc_m,
+            )
+            return new_carry, None
+
+        n_ticks = 2 * (M + S - 1)
+        init = (
+            jnp.zeros((S,) + zeros_act.shape, zeros_act.dtype),
+            zeros_act,
+            zeros_act,
+            zeros_stage,
+            zeros_pro,
+            zeros_epi,
+            jnp.zeros(()),
+            jnp.zeros(()),
+        )
+        (_, _, _, g_st, g_pro, g_epi, loss_sum, acc_sum), _ = lax.scan(
+            tick, init, jnp.arange(n_ticks)
+        )
+
+        grads = {
+            # Only stage 0 / stage S-1 hold nonzero prologue / epilogue
+            # grads; psum replicates them (and the loss) to every stage.
+            "prologue": psum_tree(g_pro, axis),
+            "stages": jax.tree.map(lambda g: g[None], g_st),
+            "epilogue": psum_tree(g_epi, axis),
+        }
+        new_params, new_opt = self.optimizer.update(grads, ts.opt_state, ts.params)
+        metrics = {
+            "loss": lax.psum(loss_sum, axis) / M,
+            "accuracy": lax.psum(acc_sum, axis) / M,
+        }
+        new_ts = TrainState(
+            params=new_params,
+            model_state=ts.model_state,
+            opt_state=new_opt,
+            step=ts.step + 1,
+        )
+        return new_ts, metrics
