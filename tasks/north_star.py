@@ -25,7 +25,7 @@ from tpudml.core.prng import seed_key
 from tpudml.data import DataLoader, ShardedDataLoader
 from tpudml.data.sampler import make_sampler
 from tpudml.metrics import MetricsWriter
-from tpudml.models import ResNet18
+from tpudml.models import ResNet18, ResNet34, ResNet50
 from tpudml.optim import make_optimizer
 from tpudml.parallel.dp import DataParallel
 from tpudml.train import evaluate, train_loop
@@ -42,7 +42,7 @@ def reference_defaults() -> TrainConfig:
     return cfg
 
 
-def run(cfg: TrainConfig, compute_dtype=jnp.bfloat16) -> dict:
+def run(cfg: TrainConfig, compute_dtype=jnp.bfloat16, model_name="resnet18") -> dict:
     init_distributed(cfg)
     devices = select_devices(cfg)
     mesh = make_mesh(MeshConfig({"data": len(devices)}), devices)
@@ -63,7 +63,10 @@ def run(cfg: TrainConfig, compute_dtype=jnp.bfloat16) -> dict:
     )
     test_loader = DataLoader(test_set, cfg.data.batch_size, drop_remainder=False)
 
-    model = ResNet18(
+    ctors = {"resnet18": ResNet18, "resnet34": ResNet34, "resnet50": ResNet50}
+    if model_name not in ctors:
+        raise ValueError(f"unknown model {model_name!r}; options: {sorted(ctors)}")
+    model = ctors[model_name](
         compute_dtype=compute_dtype, in_channels=train_set.images.shape[-1]
     )
     optimizer = make_optimizer(cfg.optimizer, cfg.lr, cfg.momentum)
@@ -110,9 +113,19 @@ def main(argv=None):
     parser.add_argument(
         "--f32", action="store_true", help="disable bf16 compute (numerics A/B)"
     )
+    parser.add_argument(
+        "--model", choices=["resnet18", "resnet34", "resnet50"],
+        default="resnet18",
+        help="resnet50 = the BASELINE.json MindSpore auto-parallel parity "
+        "config (bottleneck blocks)",
+    )
     args = parser.parse_args(argv)
     cfg = config_from_args(args)
-    return run(cfg, compute_dtype=jnp.float32 if args.f32 else jnp.bfloat16)
+    return run(
+        cfg,
+        compute_dtype=jnp.float32 if args.f32 else jnp.bfloat16,
+        model_name=args.model,
+    )
 
 
 if __name__ == "__main__":

@@ -284,3 +284,44 @@ def test_elastic_recovery_resumes_from_checkpoint(tmp_path):
     from tpudml.checkpoint import CheckpointManager
 
     assert CheckpointManager(str(ckpt)).latest_step() == 96
+
+
+def test_tpu_vm_command_builders():
+    """Env-bootstrap layer (the reference's env_setup chapter, TPU-VM
+    form): the gcloud command builders are the tested contract — stable
+    verb order, worker=all fan-out, no per-rank templating (the TPU
+    metadata supplies coordinator/rank/world)."""
+    from tpudml.launch.tpu_vm import (
+        TpuVmSpec, create_command, delete_command, pod_workflow, run_command,
+    )
+
+    spec = TpuVmSpec(name="pod0", zone="us-east5-a",
+                     accelerator_type="v5litepod-16", project="proj")
+    c = create_command(spec)
+    assert c[:6] == ["gcloud", "compute", "tpus", "tpu-vm", "create", "pod0"]
+    assert "--accelerator-type" in c and "v5litepod-16" in c
+    assert "--project" in c and "proj" in c
+
+    r = run_command(spec, "python -m tasks.task2 --epochs 2")
+    assert "--worker=all" in r
+    assert r[-1] == "python -m tasks.task2 --epochs 2"
+    assert not any("{rank}" in part or "MASTER_ADDR" in part for part in r)
+
+    wf = pod_workflow(spec, "python -m tasks.north_star", repo_dir="/src")
+    assert [w[4] for w in wf] == ["create", "scp", "ssh", "delete"]
+    # The run step cd's into exactly where scp lands the repo
+    # (DST/<basename(src)>), for any src — not just ".".
+    assert wf[1][6] == "/src" and wf[1][7] == "pod0:~"
+    assert "cd ~/src &&" in wf[2][-1]
+    assert delete_command(spec)[-1] == "--quiet"
+
+
+def test_tpu_vm_cli_dry_run(capsys):
+    from tpudml.launch import tpu_vm
+
+    rc = tpu_vm.main(["workflow", "--name", "pod1", "--command", "echo hi"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert out.count("gcloud compute tpus tpu-vm") == 4
+    assert "create pod1" in out and "delete pod1" in out
+    assert "echo hi" in out

@@ -1,0 +1,187 @@
+"""TPU-VM pod provisioning — the environment-bootstrap layer.
+
+The reference dedicates a chapter to getting an environment at all
+(sections/env_setup.tex: local CUDA+conda :5-145, Docker image workflow
+:147-283, the SIGS GPU cluster :285-360, Huawei ModelArts :364-443). The
+TPU-native analogue is the TPU-VM lifecycle: create a pod slice, run the
+SAME per-worker command on every host (jax.distributed discovers the
+coordinator from the TPU metadata, so no MASTER_ADDR plumbing), and
+delete it when done.
+
+Design: pure COMMAND BUILDERS over a typed spec + a thin CLI that prints
+(``--dry_run``, the default) or executes them. The builders are the
+tested, load-bearing part — this box has no gcloud and no pod, so
+execution is deliberately a subprocess one-liner around the exact
+commands the dry run shows (an operator can always copy-paste them).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import shlex
+import subprocess
+import sys
+from dataclasses import dataclass
+
+
+@dataclass
+class TpuVmSpec:
+    """One TPU-VM pod slice (the ClusterSpec analogue for real hardware).
+
+    ``accelerator_type`` encodes generation and chip count (e.g.
+    "v5litepod-8" = 8 v5e chips on 2 hosts, "v4-32" = 16 chips / 4 hosts);
+    the per-host process layout follows from it, so unlike the reference's
+    compose YAML there is no rank bookkeeping to keep consistent.
+    """
+
+    name: str
+    zone: str = "us-central2-b"
+    accelerator_type: str = "v5litepod-8"
+    runtime_version: str = "tpu-ubuntu2204-base"
+    project: str | None = None
+    preemptible: bool = False
+
+    def _common(self) -> list[str]:
+        out = ["--zone", self.zone]
+        if self.project:
+            out += ["--project", self.project]
+        return out
+
+
+def create_command(spec: TpuVmSpec) -> list[str]:
+    cmd = [
+        "gcloud", "compute", "tpus", "tpu-vm", "create", spec.name,
+        *spec._common(),
+        "--accelerator-type", spec.accelerator_type,
+        "--version", spec.runtime_version,
+    ]
+    if spec.preemptible:
+        cmd.append("--preemptible")
+    return cmd
+
+
+def delete_command(spec: TpuVmSpec) -> list[str]:
+    return [
+        "gcloud", "compute", "tpus", "tpu-vm", "delete", spec.name,
+        *spec._common(), "--quiet",
+    ]
+
+
+def run_command(spec: TpuVmSpec, command: str) -> list[str]:
+    """Run ``command`` on EVERY worker host simultaneously (--worker=all):
+    the pod-scale launch primitive. The same task entrypoints run
+    unchanged — ``jax.distributed.initialize()`` with no arguments
+    resolves coordinator/rank/world from the TPU-VM metadata, which is why
+    no MASTER_ADDR/--rank templating exists here (contrast the
+    reference's per-service compose commands,
+    codes/task2/docker-compose.yml:9-17,30-38)."""
+    return [
+        "gcloud", "compute", "tpus", "tpu-vm", "ssh", spec.name,
+        *spec._common(), "--worker=all", "--command", command,
+    ]
+
+
+def scp_command(spec: TpuVmSpec, src: str, dst: str) -> list[str]:
+    """Copy the code/data to every worker (the bind-mount analogue of the
+    reference's ``.:/workspace`` volumes)."""
+    return [
+        "gcloud", "compute", "tpus", "tpu-vm", "scp", "--recurse", src,
+        f"{spec.name}:{dst}", *spec._common(), "--worker=all",
+    ]
+
+
+def pod_workflow(
+    spec: TpuVmSpec, task_command: str, repo_dir: str = ".", dst: str = "~"
+) -> list[list[str]]:
+    """The full create → push code → run → delete lifecycle as a command
+    list (what ``python -m tpudml.launch.tpu_vm workflow`` prints).
+
+    ``scp --recurse SRC name:DST`` lands the repo at DST/<basename(SRC)>
+    (scp -r semantics when DST exists — and the home dir always does), so
+    the run step cd's into exactly that path; any ``repo_dir`` works, not
+    just ".".
+    """
+    import os
+
+    workdir = dst.rstrip("/") + "/" + os.path.basename(os.path.realpath(repo_dir))
+    return [
+        create_command(spec),
+        scp_command(spec, repo_dir, dst),
+        run_command(spec, f"cd {workdir} && {task_command}"),
+        delete_command(spec),
+    ]
+
+
+def _execute(cmd: list[str]) -> int:
+    print("+ " + " ".join(shlex.quote(c) for c in cmd), flush=True)
+    return subprocess.call(cmd)
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="python -m tpudml.launch.tpu_vm",
+        description="TPU-VM pod lifecycle (prints gcloud commands; "
+        "--execute runs them)",
+    )
+    p.add_argument("action", choices=["create", "run", "scp", "delete", "workflow"])
+    p.add_argument("--name", required=True)
+    for f in dataclasses.fields(TpuVmSpec):
+        if f.name in ("name", "preemptible"):
+            continue
+        p.add_argument(f"--{f.name}", default=f.default)
+    p.add_argument("--preemptible", action="store_true")
+    p.add_argument("--command", default="python -m tasks.north_star --epochs 10")
+    p.add_argument("--src", default=".")
+    p.add_argument("--dst", default="~",
+                   help="remote parent dir; the repo lands at "
+                   "DST/<basename(src)> (scp -r semantics)")
+    p.add_argument("--execute", action="store_true",
+                   help="run the commands instead of printing them")
+    args = p.parse_args(argv)
+
+    spec = TpuVmSpec(
+        name=args.name, zone=args.zone,
+        accelerator_type=args.accelerator_type,
+        runtime_version=args.runtime_version,
+        project=args.project, preemptible=args.preemptible,
+    )
+    cmds = {
+        "create": [create_command(spec)],
+        "delete": [delete_command(spec)],
+        "run": [run_command(spec, args.command)],
+        "scp": [scp_command(spec, args.src, args.dst)],
+        "workflow": pod_workflow(spec, args.command, args.src, dst=args.dst),
+    }[args.action]
+
+    if not args.execute:
+        for cmd in cmds:
+            print(" ".join(shlex.quote(c) for c in cmd))
+        return 0
+
+    if args.action != "workflow":
+        rc = 0
+        for cmd in cmds:
+            rc = _execute(cmd)
+            if rc:
+                break
+        return rc
+
+    # workflow --execute: once the pod exists it MUST be torn down even if
+    # the push or the training command fails — a leaked slice keeps
+    # billing until someone notices.
+    create, push, run_, delete = cmds
+    rc = _execute(create)
+    if rc:
+        return rc
+    for cmd in (push, run_):
+        rc = _execute(cmd)
+        if rc:
+            break
+    drc = _execute(delete)
+    return rc or drc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,6 +1,6 @@
 from tpudml.models.lenet import LeNet
 from tpudml.models.mlp import ForwardMLP
-from tpudml.models.resnet import ResNet, ResNet18, ResNet34
+from tpudml.models.resnet import ResNet, ResNet18, ResNet34, ResNet50
 from tpudml.models.staged import StagedModel, lenet_stages
 from tpudml.models.transformer import (
     TransformerBlock,
@@ -15,6 +15,7 @@ __all__ = [
     "ResNet",
     "ResNet18",
     "ResNet34",
+    "ResNet50",
     "StagedModel",
     "lenet_stages",
     "TransformerBlock",

@@ -101,14 +101,93 @@ class BasicBlock(Module):
 
 
 @dataclass(frozen=True)
+class BottleneckBlock(Module):
+    """1x1 reduce → 3x3 → 1x1 expand (×4) + shortcut — the ResNet-50/101
+    block. The 1x1 convs are pure channel matmuls, which XLA maps straight
+    onto the MXU; compute dtype handling mirrors BasicBlock (bf16 convs,
+    f32 batch-norm)."""
+
+    in_channels: int
+    mid_channels: int
+    stride: int = 1
+    compute_dtype: Any = jnp.float32
+
+    EXPANSION = 4
+
+    @property
+    def out_channels(self) -> int:
+        return self.mid_channels * self.EXPANSION
+
+    @property
+    def has_projection(self) -> bool:
+        return self.stride != 1 or self.in_channels != self.out_channels
+
+    def _layers(self):
+        conv1 = Conv2D(self.in_channels, self.mid_channels, 1, 1, "SAME", use_bias=False)
+        conv2 = Conv2D(
+            self.mid_channels, self.mid_channels, 3, self.stride, "SAME", use_bias=False
+        )
+        conv3 = Conv2D(self.mid_channels, self.out_channels, 1, 1, "SAME", use_bias=False)
+        proj = (
+            Conv2D(self.in_channels, self.out_channels, 1, self.stride, "SAME", use_bias=False)
+            if self.has_projection
+            else None
+        )
+        return conv1, conv2, conv3, proj
+
+    def init(self, key):
+        conv1, conv2, conv3, proj = self._layers()
+        k1, k2, k3, k4 = jax.random.split(key, 4)
+        params, state = {}, {}
+        for name, conv, ch, k in (
+            ("1", conv1, self.mid_channels, k1),
+            ("2", conv2, self.mid_channels, k2),
+            ("3", conv3, self.out_channels, k3),
+        ):
+            params[f"conv{name}"] = conv.init(k)[0]
+            params[f"bn{name}"], state[f"bn{name}"] = BatchNorm(ch).init(k)
+        if proj is not None:
+            params["proj"] = proj.init(k4)[0]
+            params["proj_bn"], state["proj_bn"] = BatchNorm(self.out_channels).init(k4)
+        return params, state
+
+    def _bn(self, ch, params, state, x, train):
+        y, new_state = BatchNorm(ch).apply(
+            params, state, x.astype(jnp.float32), train=train
+        )
+        return y.astype(self.compute_dtype), new_state
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        conv1, conv2, conv3, proj = self._layers()
+        cdt = self.compute_dtype
+        new_state = {}
+        shortcut = x
+        y, _ = conv1.apply(_cast(params["conv1"], cdt), {}, x)
+        y, new_state["bn1"] = self._bn(self.mid_channels, params["bn1"], state["bn1"], y, train)
+        y = jax.nn.relu(y)
+        y, _ = conv2.apply(_cast(params["conv2"], cdt), {}, y)
+        y, new_state["bn2"] = self._bn(self.mid_channels, params["bn2"], state["bn2"], y, train)
+        y = jax.nn.relu(y)
+        y, _ = conv3.apply(_cast(params["conv3"], cdt), {}, y)
+        y, new_state["bn3"] = self._bn(self.out_channels, params["bn3"], state["bn3"], y, train)
+        if proj is not None:
+            shortcut, _ = proj.apply(_cast(params["proj"], cdt), {}, x)
+            shortcut, new_state["proj_bn"] = self._bn(
+                self.out_channels, params["proj_bn"], state["proj_bn"], shortcut, train
+            )
+        return jax.nn.relu(y + shortcut), new_state
+
+
+@dataclass(frozen=True)
 class ResNet(Module):
-    """Configurable ResNet (basic blocks only — 18/34 class depths)."""
+    """Configurable ResNet: basic blocks (18/34) or bottlenecks (50/101)."""
 
     stage_sizes: tuple[int, ...] = (2, 2, 2, 2)
     num_classes: int = 10
     width: int = 64
     stem: str = "cifar"  # "cifar" (3x3/s1) or "imagenet" (7x7/s2 + pool)
     in_channels: int = 3
+    block: str = "basic"  # "basic" | "bottleneck"
     compute_dtype: Any = jnp.float32
 
     def _stem_conv(self):
@@ -120,18 +199,26 @@ class ResNet(Module):
         blocks = []
         in_ch = self.width
         for stage, n in enumerate(self.stage_sizes):
-            out_ch = self.width * (2**stage)
+            ch = self.width * (2**stage)
             for i in range(n):
                 stride = 2 if (stage > 0 and i == 0) else 1
-                blocks.append(
-                    BasicBlock(in_ch, out_ch, stride, compute_dtype=self.compute_dtype)
-                )
-                in_ch = out_ch
+                if self.block == "bottleneck":
+                    blk = BottleneckBlock(
+                        in_ch, ch, stride, compute_dtype=self.compute_dtype
+                    )
+                    in_ch = blk.out_channels
+                else:
+                    blk = BasicBlock(
+                        in_ch, ch, stride, compute_dtype=self.compute_dtype
+                    )
+                    in_ch = ch
+                blocks.append(blk)
         return blocks
 
     @property
     def feature_dim(self) -> int:
-        return self.width * (2 ** (len(self.stage_sizes) - 1))
+        top = self.width * (2 ** (len(self.stage_sizes) - 1))
+        return top * BottleneckBlock.EXPANSION if self.block == "bottleneck" else top
 
     def init(self, key):
         stem = self._stem_conv()
@@ -187,5 +274,18 @@ def ResNet34(num_classes: int = 10, compute_dtype: Any = jnp.float32, **kw) -> R
         stage_sizes=(3, 4, 6, 3),
         num_classes=num_classes,
         compute_dtype=compute_dtype,
+        **kw,
+    )
+
+
+def ResNet50(num_classes: int = 10, compute_dtype: Any = jnp.float32, **kw) -> ResNet:
+    """Bottleneck ResNet-50 — the MindSpore auto-parallel parity config of
+    BASELINE.json (`configs`: "MindSpore auto-parallel ResNet-50 ...");
+    runs under the same engines (DP/FSDP/GSPMD) as ResNet-18."""
+    return ResNet(
+        stage_sizes=(3, 4, 6, 3),
+        num_classes=num_classes,
+        compute_dtype=compute_dtype,
+        block="bottleneck",
         **kw,
     )

@@ -235,7 +235,14 @@ class GPipe:
                 lax.dynamic_index_in_dim(mb, jnp.clip(t, 0, M - 1), keepdims=False),
                 buf,
             )
-            out = self.block(local, inp)
+            # Stage s holds real data only for ticks s..s+M-1; fill/drain
+            # ghost ticks skip the block compute entirely (the cond leaves
+            # the bubble out of the runtime — its zeros never influence
+            # outbuf, so gradients are unchanged).
+            live = (t >= stage) & (t - stage < M)
+            out = lax.cond(
+                live, lambda: self.block(local, inp), lambda: jnp.zeros_like(inp)
+            )
             # Last stage banks micro-batch t-(S-1) once the fill completes.
             valid = jnp.logical_and(stage == S - 1, t >= S - 1)
             banked = lax.dynamic_update_index_in_dim(
