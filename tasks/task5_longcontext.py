@@ -28,7 +28,8 @@ next-token data with a selectable parallelism/attention strategy:
 
 Model knobs on any strategy: ``--rope`` (rotary positions),
 ``--num_kv_heads`` (GQA/MQA), ``--remat`` (ring-tick remat),
-``--moe_experts`` (Switch FFN, dense unless --parallel ep).
+``--moe_experts``/``--moe_top_k`` (Switch k=1 / GShard k=2 FFN,
+dense unless --parallel ep).
 
 Reports steady-state tokens/sec and final loss.
 
@@ -85,7 +86,9 @@ def parse_args(argv=None) -> argparse.Namespace:
         "--remat", action="store_true",
         help="accepted for compatibility (ring backward always recomputes)",
     )
-    p.add_argument("--moe_experts", type=int, default=0, help="Switch MoE FFN")
+    p.add_argument("--moe_experts", type=int, default=0, help="MoE FFN experts")
+    p.add_argument("--moe_top_k", type=int, default=1,
+                   help="experts per token (1=Switch, 2=GShard)")
     p.add_argument("--dropout", type=float, default=0.0)
     p.add_argument("--steps", type=int, default=60)
     p.add_argument("--lr", type=float, default=1e-3)
@@ -108,6 +111,7 @@ def build_engine(args, devices):
         rope=args.rope,
         remat=args.remat,
         moe_experts=args.moe_experts,
+        moe_top_k=args.moe_top_k,
         dropout=args.dropout,
     )
     opt = make_optimizer("adam", args.lr)

@@ -37,7 +37,10 @@ class TpuVmSpec:
     name: str
     zone: str = "us-central2-b"
     accelerator_type: str = "v5litepod-8"
-    runtime_version: str = "tpu-ubuntu2204-base"
+    # Must match the accelerator generation: v5e slices use the
+    # v2-alpha-tpuv5-lite runtime (the v4 default would be
+    # tpu-ubuntu2204-base) — a mismatch is rejected at create time.
+    runtime_version: str = "v2-alpha-tpuv5-lite"
     project: str | None = None
     preemptible: bool = False
 
@@ -169,17 +172,19 @@ def main(argv=None) -> int:
         return rc
 
     # workflow --execute: once the pod exists it MUST be torn down even if
-    # the push or the training command fails — a leaked slice keeps
-    # billing until someone notices.
+    # the push or the training command fails, raises, or is interrupted —
+    # a leaked slice keeps billing until someone notices.
     create, push, run_, delete = cmds
     rc = _execute(create)
     if rc:
         return rc
-    for cmd in (push, run_):
-        rc = _execute(cmd)
-        if rc:
-            break
-    drc = _execute(delete)
+    try:
+        for cmd in (push, run_):
+            rc = _execute(cmd)
+            if rc:
+                break
+    finally:
+        drc = _execute(delete)
     return rc or drc
 
 

@@ -52,6 +52,7 @@ class TransformerBlock(Module):
     moe_experts: int = 0
     moe_axis: str | None = None
     moe_capacity_factor: float = 2.0
+    moe_top_k: int = 1
     dtype: Any = jnp.float32
 
     def _parts(self):
@@ -81,6 +82,7 @@ class TransformerBlock(Module):
                 self.moe_experts,
                 mlp_ratio=self.mlp_ratio,
                 capacity_factor=self.moe_capacity_factor,
+                top_k=self.moe_top_k,
                 axis_name=self.moe_axis,
                 dtype=self.dtype,
             )
@@ -229,6 +231,7 @@ class TransformerLM(Module):
     moe_experts: int = 0
     moe_axis: str | None = None
     moe_capacity_factor: float = 2.0
+    moe_top_k: int = 1
     dtype: Any = jnp.float32
 
     def _block(self) -> TransformerBlock:
@@ -247,6 +250,7 @@ class TransformerLM(Module):
             moe_experts=self.moe_experts,
             moe_axis=self.moe_axis,
             moe_capacity_factor=self.moe_capacity_factor,
+            moe_top_k=self.moe_top_k,
             dtype=self.dtype,
         )
 

@@ -4,8 +4,9 @@ Absent from the reference (SURVEY.md §2.3 lists EP/MoE as out of parity
 scope), built here to complete the parallelism matrix. TPU-first design —
 the GShard/Switch dense-dispatch formulation, not per-token gather loops:
 
-- top-1 routing with a static per-shard expert capacity C, so every shape
-  is fixed and XLA tiles the dispatch/combine einsums onto the MXU;
+- top-k routing (k=1 Switch, k>1 GShard) with a static per-shard expert
+  capacity C, so every shape is fixed and XLA tiles the dispatch/combine
+  einsums onto the MXU;
 - dispatch is a [G, E, C] one-hot tensor: ``expert_in = einsum(
   'gec,gd->ecd')``, combine is its gate-weighted transpose — tokens past
   capacity are dropped (combine weight 0), the standard Switch trade;
@@ -36,7 +37,13 @@ from tpudml.nn.layers import Module, _uniform_fan_in
 
 @dataclass(frozen=True)
 class MoELayer(Module):
-    """Top-1 (Switch) mixture-of-experts FFN over [..., embed_dim] inputs.
+    """Top-k mixture-of-experts FFN over [..., embed_dim] inputs.
+
+    ``top_k=1`` is the Switch formulation (raw top-1 probability as the
+    gate); ``top_k>1`` is GShard-style — each token dispatches to its k
+    best experts with gates renormalized over the chosen k, capacity
+    scaled by k, and choice 0 taking buffer priority over choice 1 (a
+    token's secondary pick is dropped first under overflow).
 
     ``axis_name=None``: single-shard dense routing. ``axis_name="expert"``:
     expert-parallel — must run under shard_map with tokens sharded over the
@@ -47,8 +54,15 @@ class MoELayer(Module):
     num_experts: int
     mlp_ratio: int = 4
     capacity_factor: float = 1.25
+    top_k: int = 1
     axis_name: str | None = None
     dtype: Any = jnp.float32
+
+    def __post_init__(self):
+        if not 1 <= self.top_k <= self.num_experts:
+            raise ValueError(
+                f"top_k {self.top_k} must be in [1, num_experts={self.num_experts}]"
+            )
 
     def init(self, key):
         d, e, h = self.embed_dim, self.num_experts, self.mlp_ratio * self.embed_dim
@@ -68,7 +82,10 @@ class MoELayer(Module):
         return params, {"aux_loss": jnp.zeros((), jnp.float32)}
 
     def _capacity(self, n_tokens: int) -> int:
-        return max(1, int(n_tokens * self.capacity_factor / self.num_experts + 0.5))
+        return max(
+            1,
+            int(n_tokens * self.top_k * self.capacity_factor / self.num_experts + 0.5),
+        )
 
     def apply(self, params, state, x, *, train=False, rng=None):
         shape = x.shape
@@ -81,15 +98,32 @@ class MoELayer(Module):
 
         logits = tokens @ params["router"]["kernel"]  # [G, E]
         probs = jax.nn.softmax(logits, axis=-1)
-        expert = jnp.argmax(probs, axis=-1)  # [G]
-        gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]  # [G]
-        onehot = jax.nn.one_hot(expert, e, dtype=tokens.dtype)  # [G, E]
-        # Position of each token within its expert's capacity buffer.
-        pos = jnp.cumsum(onehot, axis=0) - onehot  # [G, E]
-        kept = onehot * (pos < cap)  # overflow dropped (Switch semantics)
-        disp = kept[:, :, None] * jax.nn.one_hot(
-            jnp.sum(pos * onehot, axis=-1).astype(jnp.int32), cap, dtype=tokens.dtype
-        )[:, None, :]  # [G, E, C]
+        topv, topi = lax.top_k(probs, self.top_k)  # [G, k]
+        if self.top_k == 1:
+            gates = topv  # Switch: the raw top-1 probability
+        else:
+            gates = topv / (jnp.sum(topv, axis=-1, keepdims=True) + 1e-9)
+
+        # Choice-priority dispatch: choice 0 claims buffer slots for ALL
+        # tokens before choice 1 sees the remaining capacity (k static and
+        # small, so the Python loop unrolls into k fused dispatch builds).
+        counts = jnp.zeros((e,), tokens.dtype)  # slots used per expert
+        disp = jnp.zeros((g, e, cap), tokens.dtype)
+        combine = jnp.zeros((g, e, cap), tokens.dtype)
+        onehot0 = None
+        for j in range(self.top_k):
+            onehot = jax.nn.one_hot(topi[:, j], e, dtype=tokens.dtype)  # [G, E]
+            if j == 0:
+                onehot0 = onehot
+            pos = counts[None, :] + jnp.cumsum(onehot, axis=0) - onehot  # [G, E]
+            kept = onehot * (pos < cap)
+            slot = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)
+            disp_j = kept[:, :, None] * jax.nn.one_hot(slot, cap, dtype=tokens.dtype)[
+                :, None, :
+            ]  # [G, E, C] (disjoint slots across choices by construction)
+            disp = disp + disp_j
+            combine = combine + disp_j * gates[:, j][:, None, None]
+            counts = counts + jnp.sum(kept, axis=0)
 
         expert_in = jnp.einsum("gec,gd->ecd", disp, tokens)  # [E, C, d]
         ep = self.axis_name is not None
@@ -110,11 +144,11 @@ class MoELayer(Module):
             expert_out = lax.all_to_all(
                 expert_out, self.axis_name, split_axis=1, concat_axis=0, tiled=True
             )
-        combine = disp * gate[:, None, None]
         y = jnp.einsum("gec,ecd->gd", combine, expert_out)
-        # Switch aux loss over this shard's tokens: E · Σ_e frac_e · p̄_e
-        # (=1 when routing is uniform); differentiable through probs.
-        frac = jnp.mean(onehot, axis=0)
+        # Switch/GShard aux loss over this shard's tokens: E · Σ_e frac_e ·
+        # p̄_e with frac from each token's FIRST choice (=1 when routing is
+        # uniform); differentiable through probs.
+        frac = jnp.mean(onehot0, axis=0)
         aux = self.num_experts * jnp.sum(frac * jnp.mean(probs, axis=0))
         return y.reshape(shape), {"aux_loss": aux}
 
