@@ -71,8 +71,21 @@ class ExpertParallel:
         mesh: Mesh,
         axis_name: str = "expert",
         aux_loss_weight: float = 1e-2,
+        batch_axis: str | None = None,
     ):
         self.model = model
+        if batch_axis is not None and (
+            batch_axis not in mesh.shape or batch_axis == axis_name
+        ):
+            raise ValueError(
+                f"batch_axis {batch_axis!r} must be a mesh axis distinct "
+                f"from the expert axis {axis_name!r} (mesh: {tuple(mesh.shape)})"
+            )
+        # EP×DP on a 2-D {"data": D, "expert": E} mesh: the batch dim
+        # shards over BOTH axes (D·E token shards), experts shard over
+        # ``expert`` and replicate over ``data``; the MoE all_to_all stays
+        # within each data replica's expert subgroup.
+        self.batch_axis = batch_axis
         # The update runs inside shard_map with expert grads device-local:
         # a global-norm clip must psum its norm over the expert axis
         # (expert leaves local, router/dense replicated) or shards would
@@ -111,22 +124,39 @@ class ExpertParallel:
         )
         return jax.device_put(ts, shardings)
 
+    def _all_axes(self):
+        return (
+            (self.batch_axis, self.axis_name)
+            if self.batch_axis is not None
+            else self.axis_name
+        )
+
+    def _batch_spec(self) -> P:
+        # Batch dim sharded over (data, expert) combined when composed —
+        # by construction the same axes the means reduce over.
+        return P(self._all_axes())
+
     def _mean_grads(self, grads: PyTree) -> PyTree:
-        axis, world = self.axis_name, self.world
+        world = self.world
+        batch_axis = self.batch_axis
 
         def fix(path, g):
             if _is_expert_path(path):
-                return g / world  # a2a transpose already summed across shards
-            return lax.pmean(g, axis)
+                g = g / world  # a2a transpose already summed across shards
+                # Experts replicate over the data axis: average the data
+                # replicas' contributions like any replicated parameter.
+                return lax.pmean(g, batch_axis) if batch_axis else g
+            return lax.pmean(g, self._all_axes())
 
         return jax.tree_util.tree_map_with_path(fix, grads)
 
     def make_forward(self) -> Callable:
+        spec = self._batch_spec()
         fwd = shard_map_fn(
             lambda params, x: self.model(params, x),
             self.mesh,
-            in_specs=(self._specs.params, P(self.axis_name)),
-            out_specs=P(self.axis_name),
+            in_specs=(self._specs.params, spec),
+            out_specs=spec,
         )
         return jax.jit(fwd)
 
@@ -135,12 +165,12 @@ class ExpertParallel:
         (correct, count) summed over the expert-data shards. Cached on the
         engine so repeated evaluate() calls reuse one compiled program."""
         if self._eval_step is None:
-            axis = self.axis_name
+            spec = self._batch_spec()
             self._eval_step = make_counting_eval_step(
                 self.model,
                 self.mesh,
-                (self._specs.params, self._specs.model_state, P(axis), P(axis)),
-                axis,
+                (self._specs.params, self._specs.model_state, spec, spec),
+                self._all_axes(),
             )
         return self._eval_step
 
@@ -148,8 +178,6 @@ class ExpertParallel:
         return evaluate_counts(self.make_eval_step(), ts, loader)
 
     def make_train_step(self) -> Callable:
-        axis = self.axis_name
-
         def spmd(ts: TrainState, x, labels):
             def loss_fn(params):
                 loss, aux = self._loss_fn(params, ts.model_state, x, labels, None)
@@ -161,15 +189,21 @@ class ExpertParallel:
             grads = self._mean_grads(grads)
             # Replicated (non-expert) model state, e.g. BN stats, must stay
             # shard-consistent — same treatment as the DP/CP engines;
-            # expert-owned state stays local to its shard.
+            # expert-owned state stays local to its expert shard (averaged
+            # over data replicas when composed).
+            batch_axis = self.batch_axis
             model_state = jax.tree_util.tree_map_with_path(
-                lambda path, s: s if _is_expert_path(path) else lax.pmean(s, axis),
+                lambda path, s: (
+                    (lax.pmean(s, batch_axis) if batch_axis else s)
+                    if _is_expert_path(path)
+                    else lax.pmean(s, self._all_axes())
+                ),
                 model_state,
             )
             new_params, new_opt = self.optimizer.update(grads, ts.opt_state, ts.params)
             metrics = {
-                "loss": lax.pmean(loss, axis),
-                "accuracy": lax.pmean(accuracy(logits, labels), axis),
+                "loss": lax.pmean(loss, self._all_axes()),
+                "accuracy": lax.pmean(accuracy(logits, labels), self._all_axes()),
             }
             new_ts = TrainState(
                 params=new_params,
@@ -182,11 +216,12 @@ class ExpertParallel:
         specs = self._specs
         # Donate the TrainState: expert params/opt-state rewrite in place.
         # Input state is CONSUMED; callers must rebind ts every step.
+        batch_spec = self._batch_spec()
         jitted = jax.jit(
             shard_map_fn(
                 spmd,
                 self.mesh,
-                in_specs=(specs, P(axis), P(axis)),
+                in_specs=(specs, batch_spec, batch_spec),
                 out_specs=(specs, P()),
             ),
             donate_argnums=(0,),
