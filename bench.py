@@ -147,7 +147,9 @@ def bench_transformer(on_tpu: bool) -> dict:
         max_len=seq_len,
         impl="flash" if on_tpu else "full",
         rope=True,
-        dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+        # Master-weight mixed precision: f32 params (the optimizer state),
+        # bf16 MXU compute, f32 norms/softmax/logits.
+        compute_dtype=jnp.bfloat16 if on_tpu else None,
     )
     opt = make_optimizer("adamw", 3e-4)
     seqs = jnp.asarray(synthetic_lm(batch, seq_len + 1, cfg["vocab_size"], seed=1))

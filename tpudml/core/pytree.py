@@ -1,0 +1,20 @@
+"""Pytree key-path helpers shared by every module that classifies
+parameters by their tree path (sharding rules, expert-leaf detection,
+mixed-precision cast filters). One copy, so a JAX key-type change (e.g.
+a new SequenceKey spelling) can't silently diverge path matching between
+the classifiers."""
+
+from __future__ import annotations
+
+
+def key_name(k) -> str | None:
+    """The human name of one pytree key entry (DictKey.key /
+    GetAttrKey.name / SequenceKey.idx), or its str as a last resort."""
+    for attr in ("key", "name", "idx"):
+        if hasattr(k, attr):
+            return getattr(k, attr)
+    return str(k)
+
+
+def path_names(key_path) -> tuple:
+    return tuple(key_name(k) for k in key_path)

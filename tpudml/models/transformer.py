@@ -233,6 +233,15 @@ class TransformerLM(Module):
     moe_capacity_factor: float = 2.0
     moe_top_k: int = 1
     dtype: Any = jnp.float32
+    # Mixed precision, ResNet-style: parameters stay in ``dtype`` (the f32
+    # master copy the optimizer updates) and are cast per-apply to
+    # ``compute_dtype`` so the matmuls hit the MXU at bf16 throughput.
+    # Norm scales/biases and the router stay f32 (LayerNorm statistics and
+    # routing softmax are computed in f32 regardless); logits return f32.
+    # None means "compute in the parameter dtype" — NOT the same as
+    # jnp.float32: the legacy all-bf16 mode (dtype=bf16, compute_dtype
+    # unset) must keep computing in bf16, not get upcast.
+    compute_dtype: Any = None
 
     def _block(self) -> TransformerBlock:
         return TransformerBlock(
@@ -286,7 +295,21 @@ class TransformerLM(Module):
                 states[f"block{i}"] = s  # MoE aux-loss slots
         return params, states
 
+    def _cast_params(self, params):
+        if self.compute_dtype is None:
+            return params
+        keep_f32 = {"ln1", "ln2", "ln_f", "router"}
+
+        from tpudml.core.pytree import path_names
+
+        def cast(path, p):
+            names = set(path_names(path))
+            return p if names & keep_f32 else p.astype(self.compute_dtype)
+
+        return jax.tree_util.tree_map_with_path(cast, params)
+
     def apply(self, params, state, tokens, *, train=False, rng=None):
+        params = self._cast_params(params)
         embed_keys = ("tok_embed",) + (() if self.rope else ("pos_embed",))
         h = self._embed()({k: params[k] for k in embed_keys}, tokens)
         block = self._block()
@@ -300,4 +323,6 @@ class TransformerLM(Module):
             if s:
                 new_state[f"block{i}"] = s
         logits = self._head()({k: params[k] for k in ("ln_f", "head")}, h)
+        if self.compute_dtype is not None:
+            logits = logits.astype(jnp.float32)  # f32 loss/softmax
         return logits, new_state

@@ -240,10 +240,17 @@ class LayerNorm(Module):
         }, {}
 
     def apply(self, params, state, x, *, train=False, rng=None):
-        mean = jnp.mean(x, axis=-1, keepdims=True)
-        var = jnp.var(x, axis=-1, keepdims=True)
-        y = (x - mean) * lax.rsqrt(var + self.eps)
-        return y * params["scale"] + params["bias"], state
+        # Statistics always in float32 (bf16 mean/var is numerically weak
+        # at transformer widths); result back in the input dtype so the
+        # bf16 compute path stays bf16 end to end.
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.var(xf, axis=-1, keepdims=True)
+        y = (xf - mean) * lax.rsqrt(var + self.eps)
+        y = y * params["scale"].astype(jnp.float32) + params["bias"].astype(
+            jnp.float32
+        )
+        return y.astype(x.dtype), state
 
 
 @dataclass(frozen=True)

@@ -107,25 +107,30 @@ class MoELayer(Module):
         # Choice-priority dispatch: choice 0 claims buffer slots for ALL
         # tokens before choice 1 sees the remaining capacity (k static and
         # small, so the Python loop unrolls into k fused dispatch builds).
-        counts = jnp.zeros((e,), tokens.dtype)  # slots used per expert
-        disp = jnp.zeros((g, e, cap), tokens.dtype)
-        combine = jnp.zeros((g, e, cap), tokens.dtype)
+        # Bookkeeping stays float32 regardless of the token dtype — bf16
+        # represents integers exactly only to 256, so a bf16 cumsum would
+        # corrupt capacity positions on any real batch.
+        counts = jnp.zeros((e,), jnp.float32)  # slots used per expert
+        disp = jnp.zeros((g, e, cap), jnp.float32)
+        combine = jnp.zeros((g, e, cap), jnp.float32)
         onehot0 = None
         for j in range(self.top_k):
-            onehot = jax.nn.one_hot(topi[:, j], e, dtype=tokens.dtype)  # [G, E]
+            onehot = jax.nn.one_hot(topi[:, j], e, dtype=jnp.float32)  # [G, E]
             if j == 0:
                 onehot0 = onehot
             pos = counts[None, :] + jnp.cumsum(onehot, axis=0) - onehot  # [G, E]
             kept = onehot * (pos < cap)
             slot = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)
-            disp_j = kept[:, :, None] * jax.nn.one_hot(slot, cap, dtype=tokens.dtype)[
+            disp_j = kept[:, :, None] * jax.nn.one_hot(slot, cap, dtype=jnp.float32)[
                 :, None, :
             ]  # [G, E, C] (disjoint slots across choices by construction)
             disp = disp + disp_j
             combine = combine + disp_j * gates[:, j][:, None, None]
             counts = counts + jnp.sum(kept, axis=0)
 
-        expert_in = jnp.einsum("gec,gd->ecd", disp, tokens)  # [E, C, d]
+        expert_in = jnp.einsum(
+            "gec,gd->ecd", disp.astype(tokens.dtype), tokens
+        )  # [E, C, d]
         ep = self.axis_name is not None
         if ep:
             # Ship each expert's buffer to its owning shard: [E, C, d] →
@@ -144,7 +149,7 @@ class MoELayer(Module):
             expert_out = lax.all_to_all(
                 expert_out, self.axis_name, split_axis=1, concat_axis=0, tiled=True
             )
-        y = jnp.einsum("gec,ecd->gd", combine, expert_out)
+        y = jnp.einsum("gec,ecd->gd", combine.astype(expert_out.dtype), expert_out)
         # Switch/GShard aux loss over this shard's tokens: E · Σ_e frac_e ·
         # p̄_e with frac from each token's FIRST choice (=1 when routing is
         # uniform); differentiable through probs.

@@ -40,11 +40,9 @@ PyTree = Any
 
 
 def _is_expert_path(key_path) -> bool:
-    for k in key_path:
-        name = getattr(k, "key", getattr(k, "name", None))
-        if name == "experts":
-            return True
-    return False
+    from tpudml.core.pytree import key_name
+
+    return any(key_name(k) == "experts" for k in key_path)
 
 
 def expert_specs(params: PyTree, axis_name: str) -> PyTree:

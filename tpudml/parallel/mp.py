@@ -124,13 +124,7 @@ def tensor_parallel_rules(axis_name: str = "model") -> RuleFn:
     return rule
 
 
-def _path_names(key_path) -> tuple:
-    names = []
-    for k in key_path:
-        names.append(
-            getattr(k, "key", getattr(k, "name", getattr(k, "idx", str(k))))
-        )
-    return tuple(names)
+from tpudml.core.pytree import path_names as _path_names  # shared classifier
 
 
 def apply_rules(rule: RuleFn, params: PyTree, mesh: Mesh) -> PyTree:
