@@ -21,6 +21,13 @@ dimension), but first-class here: long sequences are sharded over a mesh
 Both are pure jittable functions (must run under shard_map with
 ``axis_name`` bound) and differentiate exactly — ppermute/all_to_all
 transpose to their inverses, so gradients route back to the owning shard.
+
+Known trade (future work): causal ring ticks skip fully-masked blocks,
+which halves FLOPs but not lockstep latency — the last device computes at
+every tick. The fix is zigzag chunk assignment (device i holds chunks
+(i, 2W−1−i)), which balances per-tick work at the cost of position-mapped
+masking through the embed/RoPE/kernel paths; the contiguous layout here
+keeps global positions affine, which everything downstream relies on.
 """
 
 from __future__ import annotations
