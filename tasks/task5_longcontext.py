@@ -73,6 +73,10 @@ def parse_args(argv=None) -> argparse.Namespace:
     )
     p.add_argument("--attn", choices=["full", "flash", "ring", "ulysses"], default=None,
                    help="attention impl; defaults: single/dp/tp=full, cp=ring")
+    p.add_argument("--cp_layout", choices=["contiguous", "striped"],
+                   default="contiguous",
+                   help="ring-CP token layout; striped balances causal work "
+                   "across the ring (~2x causal wall-clock on TPU)")
     p.add_argument("--n_devices", type=int, default=None)
     p.add_argument("--seq_len", type=int, default=256)
     p.add_argument("--batch_size", type=int, default=8, help="global batch (sequences)")
@@ -139,9 +143,15 @@ def build_engine(args, devices):
         impl = args.attn or "ring"
         if impl not in ("ring", "ulysses"):
             raise ValueError("cp needs --attn ring|ulysses")
+        if args.cp_layout == "striped" and impl != "ring":
+            raise ValueError("--cp_layout striped requires --attn ring")
         mesh = make_mesh(MeshConfig({"seq": n}), devices)
-        model = TransformerLM(**base, impl=impl, seq_sharded=True)
-        engine = ContextParallel(model, opt, mesh, rng_root=rng_root)
+        model = TransformerLM(
+            **base, impl=impl, seq_sharded=True, seq_layout=args.cp_layout
+        )
+        engine = ContextParallel(
+            model, opt, mesh, rng_root=rng_root, layout=args.cp_layout
+        )
         return engine.create_state(seed_key(args.seed)), engine.make_train_step()
     impl = args.attn or "full"
     model = TransformerLM(**base, impl=impl)

@@ -47,6 +47,7 @@ class TransformerBlock(Module):
     rope: bool = False
     rope_base: float = 10000.0
     seq_sharded: bool = False
+    seq_layout: str = "contiguous"
     dropout: float = 0.0  # on attention + FFN outputs (train mode, needs rng)
     mlp_ratio: int = 4
     moe_experts: int = 0
@@ -70,6 +71,7 @@ class TransformerBlock(Module):
                 rope=self.rope,
                 rope_base=self.rope_base,
                 seq_sharded=self.seq_sharded,
+                seq_layout=self.seq_layout,
                 dtype=self.dtype,
             ),
             "ln2": LayerNorm(d, dtype=self.dtype),
@@ -146,6 +148,7 @@ class TransformerEmbed(Module):
     max_len: int = 1024
     axis_name: str = "seq"
     seq_sharded: bool = False
+    seq_layout: str = "contiguous"  # "striped" = balanced causal-ring layout
     use_pos_embed: bool = True  # False when positions come from RoPE
     dtype: Any = jnp.float32
 
@@ -177,10 +180,18 @@ class TransformerEmbed(Module):
             )
         h = params["tok_embed"][tokens]
         if self.use_pos_embed:
-            offset = (
-                lax.axis_index(self.axis_name) * t_local if self.seq_sharded else 0
-            )
-            h = h + params["pos_embed"][offset + jnp.arange(t_local)]
+            if not self.seq_sharded:
+                positions = jnp.arange(t_local)
+            elif self.seq_layout == "striped":
+                world = lax.axis_size(self.axis_name)
+                positions = lax.axis_index(self.axis_name) + world * jnp.arange(
+                    t_local
+                )
+            else:
+                positions = lax.axis_index(self.axis_name) * t_local + jnp.arange(
+                    t_local
+                )
+            h = h + params["pos_embed"][positions]
         return h, state
 
 
@@ -223,6 +234,7 @@ class TransformerLM(Module):
     impl: str = "full"
     axis_name: str = "seq"
     seq_sharded: bool = False
+    seq_layout: str = "contiguous"
     remat: bool = False
     num_kv_heads: int | None = None
     rope: bool = False
@@ -255,6 +267,7 @@ class TransformerLM(Module):
             rope=self.rope,
             rope_base=self.rope_base,
             seq_sharded=self.seq_sharded,
+            seq_layout=self.seq_layout,
             dropout=self.dropout,
             moe_experts=self.moe_experts,
             moe_axis=self.moe_axis,
@@ -275,6 +288,7 @@ class TransformerLM(Module):
             self.max_len,
             axis_name=self.axis_name,
             seq_sharded=self.seq_sharded,
+            seq_layout=self.seq_layout,
             use_pos_embed=not self.rope,
             dtype=self.dtype,
         )

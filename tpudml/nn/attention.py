@@ -101,6 +101,10 @@ class MultiHeadAttention(Module):
     rope: bool = False  # rotary position embeddings on q/k
     rope_base: float = 10000.0
     seq_sharded: bool = False  # rope offsets from axis_name when sharded
+    # Sharded-sequence token layout: "contiguous" (device i owns
+    # [i·Tl, (i+1)·Tl)) or "striped" (device i owns {t : t mod W == i} —
+    # the balanced causal-ring layout; positions become idx + W·j).
+    seq_layout: str = "contiguous"
     dtype: Any = jnp.float32
 
     def __post_init__(self):
@@ -112,6 +116,16 @@ class MultiHeadAttention(Module):
         if kv is not None and (kv < 1 or self.num_heads % kv):
             raise ValueError(
                 f"num_kv_heads {kv} must divide num_heads {self.num_heads}"
+            )
+        if self.seq_layout not in ("contiguous", "striped"):
+            raise ValueError(f"unknown seq_layout {self.seq_layout!r}")
+        if self.seq_layout == "striped" and self.impl != "ring":
+            # Ulysses/full gather shards in device order — under striping
+            # that is a PERMUTED sequence, so their causal masks would
+            # silently let tokens attend the future. Only the ring fold
+            # understands striped positions.
+            raise ValueError(
+                f"seq_layout='striped' requires impl='ring', got {self.impl!r}"
             )
         if self.rope and (self.embed_dim // self.num_heads) % 2:
             # RoPE rotates feature PAIRS; an odd head_dim would silently
@@ -163,10 +177,13 @@ class MultiHeadAttention(Module):
         if self.rope:
             # Before the GQA repeat: rotating the kv_heads-wide tensor does
             # group× less work and repeating rotated heads is identical.
-            offset = (
-                jax.lax.axis_index(self.axis_name) * t if self.seq_sharded else 0
-            )
-            positions = offset + jnp.arange(t)
+            if not self.seq_sharded:
+                positions = jnp.arange(t)
+            elif self.seq_layout == "striped":
+                world = jax.lax.axis_size(self.axis_name)
+                positions = jax.lax.axis_index(self.axis_name) + world * jnp.arange(t)
+            else:
+                positions = jax.lax.axis_index(self.axis_name) * t + jnp.arange(t)
             q = rotary_embedding(q, positions, self.rope_base)
             k = rotary_embedding(k, positions, self.rope_base)
         if self._kv_heads != self.num_heads:
@@ -185,7 +202,8 @@ class MultiHeadAttention(Module):
             from tpudml.parallel.cp import ring_attention
 
             o = ring_attention(
-                q, k, v, self.axis_name, causal=self.causal, remat=self.remat
+                q, k, v, self.axis_name, causal=self.causal, remat=self.remat,
+                layout=self.seq_layout,
             )
         elif self.impl == "ulysses":
             from tpudml.parallel.cp import ulysses_attention

@@ -69,15 +69,18 @@ def _unfold(x, b, h, t, d):
     return x[:, :t].reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
 
-def _scores(q, k, qi, kj, *, scale, causal, block_q, block_k, t_valid, nk):
-    """Recomputable masked score tile [block_q, block_k] in f32."""
+def _scores(q, k, qi, kj, *, scale, causal, block_q, block_k, t_valid, nk,
+            k_shift=0):
+    """Recomputable masked score tile [block_q, block_k] in f32.
+    ``k_shift`` offsets the causal diagonal (striped ring layout: blocks
+    from later-striped devices are visible only STRICTLY below it)."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * scale
     k_pos = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
     if causal:
         q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        s = jnp.where(q_pos >= k_pos + k_shift, s, NEG_INF)
     if t_valid != block_k * nk:  # static: nk is a trace-time constant
         # Padded keys (K rounded up to its tile multiple) must get no
         # attention mass; padded Q rows are sliced off outside.
@@ -90,7 +93,7 @@ def _scores(q, k, qi, kj, *, scale, causal, block_q, block_k, t_valid, nk):
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
                 scale: float, causal: bool, block_q: int, block_k: int,
-                t_valid: int):
+                t_valid: int, k_shift: int = 0):
     kj = pl.program_id(2)
     qi = pl.program_id(1)
     nk = pl.num_programs(2)
@@ -105,6 +108,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         s = _scores(
             q_ref[0], k_ref[0], qi, kj, scale=scale, causal=causal,
             block_q=block_q, block_k=block_k, t_valid=t_valid, nk=nk,
+            k_shift=k_shift,
         )
         m_prev = m_ref[:]  # [block_q, 1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -119,9 +123,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         m_ref[:] = m_new
 
     if causal:
-        # Skip K tiles entirely above the diagonal: tile (qi, kj)
-        # contributes only if its last query row can attend its first key.
-        pl.when((qi + 1) * block_q - 1 >= kj * block_k)(fold_block)
+        # Skip K tiles entirely above the (shifted) diagonal: tile
+        # (qi, kj) contributes only if its last query row can attend its
+        # first key.
+        pl.when((qi + 1) * block_q - 1 >= kj * block_k + k_shift)(fold_block)
     else:
         fold_block()
 
@@ -131,7 +136,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         lse_ref[0] = m_ref[:] + jnp.log(l_ref[:])
 
 
-def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
+def _flash_forward(q, k, v, causal, block_q, block_k, interpret, k_shift=0):
     """Returns (out [B,T,H,D], lse [B·H, t_pad_q, 1] f32)."""
     b, t, h, d = q.shape
     scale = 1.0 / (d ** 0.5)
@@ -141,7 +146,7 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
     out, lse = pl.pallas_call(
         partial(
             _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
-            block_k=block_k, t_valid=t,
+            block_k=block_k, t_valid=t, k_shift=k_shift,
         ),
         out_shape=[
             jax.ShapeDtypeStruct(qf.shape, q.dtype),
@@ -171,7 +176,8 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               acc_ref, *, scale, causal, block_q, block_k, t_valid):
+               acc_ref, *, scale, causal, block_q, block_k, t_valid,
+               k_shift: int = 0):
     kj = pl.program_id(2)
     qi = pl.program_id(1)
     nk = pl.num_programs(2)
@@ -187,6 +193,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         s = _scores(
             q_ref[0], k, qi, kj, scale=scale, causal=causal,
             block_q=block_q, block_k=block_k, t_valid=t_valid, nk=nk,
+            k_shift=k_shift,
         )
         p = jnp.exp(s - lse_ref[0])  # lse_ref[0]: [bq, 1]
         dp = jax.lax.dot_general(
@@ -199,7 +206,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         )
 
     if causal:
-        pl.when((qi + 1) * block_q - 1 >= kj * block_k)(fold_block)
+        pl.when((qi + 1) * block_q - 1 >= kj * block_k + k_shift)(fold_block)
     else:
         fold_block()
 
@@ -210,7 +217,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _dkdv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
                  dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, block_q,
-                 block_k, t_valid, nk):
+                 block_k, t_valid, nk, k_shift: int = 0):
     qi = pl.program_id(2)
     kj = pl.program_id(1)
     nq = pl.num_programs(2)
@@ -227,6 +234,7 @@ def _dkdv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
         s = _scores(
             q, k_ref[0], qi, kj, scale=scale, causal=causal,
             block_q=block_q, block_k=block_k, t_valid=t_valid, nk=nk,
+            k_shift=k_shift,
         )
         p = jnp.exp(s - lse_ref[0])  # lse_ref[0]: [bq, 1]
         dv_acc[:] += jax.lax.dot_general(
@@ -243,7 +251,7 @@ def _dkdv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
         )  # dsᵀ·Q → [bk, d]
 
     if causal:
-        pl.when((qi + 1) * block_q - 1 >= kj * block_k)(fold_block)
+        pl.when((qi + 1) * block_q - 1 >= kj * block_k + k_shift)(fold_block)
     else:
         fold_block()
 
@@ -270,7 +278,7 @@ def _flash_backward(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
 
 
 def _backward_calls(qf, kf, vf, dof, lse, delta, b, h, t, d, causal, block_q,
-                    block_k, t_pad_q, t_pad_k, interpret):
+                    block_k, t_pad_q, t_pad_k, interpret, k_shift=0):
     """The two backward pallas_calls on pre-folded [B·H, t_pad, ·] inputs
     (shared by the full backward and the per-block ring entry point)."""
     scale = 1.0 / (d ** 0.5)
@@ -282,7 +290,7 @@ def _backward_calls(qf, kf, vf, dof, lse, delta, b, h, t, d, causal, block_q,
     dqf = pl.pallas_call(
         partial(
             _dq_kernel, scale=scale, causal=causal, block_q=block_q,
-            block_k=block_k, t_valid=t,
+            block_k=block_k, t_valid=t, k_shift=k_shift,
         ),
         out_shape=jax.ShapeDtypeStruct(qf.shape, qf.dtype),
         grid=(bh, nq, nk),
@@ -305,7 +313,7 @@ def _backward_calls(qf, kf, vf, dof, lse, delta, b, h, t, d, causal, block_q,
     dkf, dvf = pl.pallas_call(
         partial(
             _dkdv_kernel, scale=scale, causal=causal, block_q=block_q,
-            block_k=block_k, t_valid=t, nk=nk,
+            block_k=block_k, t_valid=t, nk=nk, k_shift=k_shift,
         ),
         out_shape=[
             jax.ShapeDtypeStruct(kf.shape, kf.dtype),
@@ -349,6 +357,7 @@ def flash_forward_lse(
     v: jax.Array,
     *,
     causal: bool = False,
+    k_shift: int = 0,
     block_q: int = 128,
     block_k: int = 512,
     interpret: bool = False,
@@ -358,10 +367,13 @@ def flash_forward_lse(
     Returns (out [B,T,H,D], lse [B,H,T] f32). ``causal`` here masks by
     LOCAL tile positions — for a ring block pair this is exactly the
     diagonal (same-length, aligned) block; off-diagonal visible blocks
-    pass causal=False.
+    pass causal=False. ``k_shift=1`` makes the diagonal strict (the
+    striped ring layout's later-device blocks).
     """
     b, t, h, _ = q.shape
-    out, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    out, lse = _flash_forward(
+        q, k, v, causal, block_q, block_k, interpret, k_shift=k_shift
+    )
     return out, lse[:, :t, 0].reshape(b, h, t)
 
 
@@ -374,6 +386,7 @@ def flash_block_grads(
     delta: jax.Array,
     *,
     causal: bool = False,
+    k_shift: int = 0,
     block_q: int = 128,
     block_k: int = 512,
     interpret: bool = False,
@@ -393,7 +406,7 @@ def flash_block_grads(
     deltaf = _fold_rows(delta.astype(jnp.float32), t_pad_q)
     dqf, dkf, dvf = _backward_calls(
         qf, kf, vf, dof, lsef, deltaf, b, h, t, d, causal, block_q, block_k,
-        t_pad_q, t_pad_k, interpret,
+        t_pad_q, t_pad_k, interpret, k_shift=k_shift,
     )
     return tuple(_unfold(x, b, h, t, d) for x in (dqf, dkf, dvf))
 

@@ -22,12 +22,14 @@ Both are pure jittable functions (must run under shard_map with
 ``axis_name`` bound) and differentiate exactly — ppermute/all_to_all
 transpose to their inverses, so gradients route back to the owning shard.
 
-Known trade (future work): causal ring ticks skip fully-masked blocks,
-which halves FLOPs but not lockstep latency — the last device computes at
-every tick. The fix is zigzag chunk assignment (device i holds chunks
-(i, 2W−1−i)), which balances per-tick work at the cost of position-mapped
-masking through the embed/RoPE/kernel paths; the contiguous layout here
-keeps global positions affine, which everything downstream relies on.
+Causal layouts: the default contiguous sharding skips fully-masked blocks
+(halves FLOPs, but the last device computes every tick, bounding lockstep
+latency); ``layout="striped"`` (token t on device t mod W — the striped-
+attention layout) makes every ring block a balanced triangular tile, so
+per-tick work is equal across the ring (~2× faster causal wall-clock on
+the kernel path). Positions stay affine under striping (idx + W·j), which
+is why it threads cleanly through RoPE/pos-embed and the flash kernel's
+shifted-diagonal mask.
 """
 
 from __future__ import annotations
@@ -60,13 +62,14 @@ from tpudml.train import (
 PyTree = Any
 
 
-def _block_scores(q, kb, diag: bool) -> jax.Array:
+def _block_scores(q, kb, diag: bool, k_shift: int = 0) -> jax.Array:
     """Shared scaled-masked score tile [B,H,Tq,Tk] f32 — forward and
     backward recompute through this one function so the mask/scale
     convention can never diverge between them. ``diag`` applies the
-    aligned same-length causal mask (the ring's diagonal block); visible
-    off-diagonal blocks pass False (every key precedes every query
-    globally)."""
+    aligned same-length causal mask (the ring's diagonal block) with the
+    key positions offset by ``k_shift`` (striped layout: a block from a
+    later-striped device is visible only STRICTLY below the diagonal);
+    visible off-diagonal blocks pass False."""
     d = q.shape[-1]
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
     s = (
@@ -75,15 +78,15 @@ def _block_scores(q, kb, diag: bool) -> jax.Array:
     )
     if diag:
         t = q.shape[1]
-        mask = jnp.arange(t)[:, None] >= jnp.arange(t)[None, :]
+        mask = jnp.arange(t)[:, None] >= (jnp.arange(t)[None, :] + k_shift)
         s = jnp.where(mask[None, None], s, NEG_INF)
     return s
 
 
-def _block_fwd_math(q, kb, vb, diag: bool):
+def _block_fwd_math(q, kb, vb, diag: bool, k_shift=0):
     """Reference-math per-block attention partial: (out [B,Tl,H,D] f32,
     lse [B,H,Tl] f32)."""
-    s = _block_scores(q, kb, diag)
+    s = _block_scores(q, kb, diag, k_shift)
     m = jnp.max(s, axis=-1)
     p = jnp.exp(s - m[..., None])
     l = jnp.sum(p, axis=-1)
@@ -92,13 +95,13 @@ def _block_fwd_math(q, kb, vb, diag: bool):
     return out, m + jnp.log(l)
 
 
-def _block_bwd_math(q, kb, vb, do, lse, delta, diag: bool):
+def _block_bwd_math(q, kb, vb, do, lse, delta, diag: bool, k_shift=0):
     """Reference-math per-block flash backward with global (lse, Δ):
     p = exp(s − lse); dv = pᵀ·dO; ds = p ⊙ (dO·Vᵀ − Δ); dq = scale·ds·K;
     dk = scale·dsᵀ·Q. Summing over blocks gives the exact gradients."""
     d = q.shape[-1]
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
-    s = _block_scores(q, kb, diag)
+    s = _block_scores(q, kb, diag, k_shift)
     p = jnp.exp(s - lse[..., None])  # [B,H,Tq,Tk]
     dof = do.astype(jnp.float32)
     dv = jnp.einsum("bhqk,bqhd->bkhd", p, dof)
@@ -127,24 +130,34 @@ def _merge_blocks(acc, out_b, lse_b):
 def _ring_fwd(axis_name, causal, flash_cfg, q, k, v):
     """Forward ring pass → (out, lse) local shards.
 
-    Causal runs SKIP fully-masked blocks (src > idx): more than half the
-    ring ticks in expectation carry no visible keys for this device, and
-    the lax.cond leaves their block compute out of the runtime entirely
-    (the ppermute rotation still runs every tick — collectives must stay
-    unconditional across the mesh)."""
-    use_flash, interpret = flash_cfg
+    Two causal regimes by token layout:
+
+    - **contiguous** (device i owns tokens [i·Tl, (i+1)·Tl)): fully-masked
+      blocks (src > idx) are SKIPPED — the lax.cond leaves their compute
+      out of the runtime entirely. Halves total FLOPs, but lockstep
+      latency is still bounded by the last device, which computes at every
+      tick.
+    - **striped** (device i owns tokens {t : t mod W == i}): every block
+      pair is a (shifted-)triangular causal tile — src ≤ idx masks at the
+      diagonal, src > idx strictly below it — so every device does the
+      SAME half-tile work each tick: balanced, ~2× faster wall-clock on
+      the kernel path (whose tile-skipping realizes the triangle).
+
+    The ppermute rotation runs every tick regardless — collectives must
+    stay unconditional across the mesh."""
+    use_flash, interpret, striped = flash_cfg
     world = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     b, t_local, h, d = q.shape
 
-    def block_fwd(q_, kb, vb, diag):
+    def block_fwd(q_, kb, vb, diag, k_shift=0):
         if use_flash:
             from tpudml.ops import flash_forward_lse
 
             return flash_forward_lse(
-                q_, kb, vb, causal=diag, interpret=interpret
+                q_, kb, vb, causal=diag, k_shift=k_shift, interpret=interpret
             )
-        return _block_fwd_math(q_, kb, vb, diag)
+        return _block_fwd_math(q_, kb, vb, diag, k_shift)
 
     init = (
         jnp.zeros((b, t_local, h, d), jnp.float32),
@@ -159,7 +172,16 @@ def _ring_fwd(axis_name, causal, flash_cfg, q, k, v):
         kb = ppermute_ring(kb, axis_name)
         vb = ppermute_ring(vb, axis_name)
         src = (idx - step) % world
-        if causal:
+        if causal and striped:
+            # k_shift must be static for the kernel; both variants are the
+            # same triangular tile up to the diagonal inclusion.
+            acc = lax.cond(
+                src > idx,
+                lambda a: _merge_blocks(a, *block_fwd(q, kb, vb, True, 1)),
+                lambda a: _merge_blocks(a, *block_fwd(q, kb, vb, True, 0)),
+                acc,
+            )
+        elif causal:
             acc = lax.cond(
                 src < idx,
                 lambda a: _merge_blocks(a, *block_fwd(q, kb, vb, False)),
@@ -194,7 +216,7 @@ def _ring_attn_bwd(axis_name, causal, flash_cfg, res, g):
     arrive home after a full ring revolution. Nothing from the forward
     scan is stored (flash-style recompute), so residual memory is O(local
     shard), independent of the ring size."""
-    use_flash, interpret = flash_cfg
+    use_flash, interpret, striped = flash_cfg
     q, k, v, out, lse = res
     world = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
@@ -203,14 +225,15 @@ def _ring_attn_bwd(axis_name, causal, flash_cfg, res, g):
         g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
     ).transpose(0, 2, 1)  # [B, H, Tl]
 
-    def block_bwd(q_, kb, vb, diag):
+    def block_bwd(q_, kb, vb, diag, k_shift=0):
         if use_flash:
             from tpudml.ops import flash_block_grads
 
             return flash_block_grads(
-                q_, kb, vb, g, lse, delta, causal=diag, interpret=interpret
+                q_, kb, vb, g, lse, delta, causal=diag, k_shift=k_shift,
+                interpret=interpret,
             )
-        return _block_bwd_math(q_, kb, vb, g, lse, delta, diag)
+        return _block_bwd_math(q_, kb, vb, g, lse, delta, diag, k_shift)
 
     # Tick 0: local diagonal block. Gradient accumulators (stationary dq,
     # traveling dk/dv) stay float32 regardless of the model dtype.
@@ -225,12 +248,19 @@ def _ring_attn_bwd(axis_name, causal, flash_cfg, res, g):
         dvb = ppermute_ring(dvb, axis_name)
         src = (idx - step) % world
 
-        def fold(args):
+        def fold(args, diag=False, k_shift=0):
             dq_acc, dkb, dvb = args
-            dq_i, dk_i, dv_i = block_bwd(q, kb, vb, False)
+            dq_i, dk_i, dv_i = block_bwd(q, kb, vb, diag, k_shift)
             return dq_acc + f32(dq_i), dkb + f32(dk_i), dvb + f32(dv_i)
 
-        if causal:
+        if causal and striped:
+            dq_acc, dkb, dvb = lax.cond(
+                src > idx,
+                lambda a: fold(a, True, 1),
+                lambda a: fold(a, True, 0),
+                (dq_acc, dkb, dvb),
+            )
+        elif causal:
             dq_acc, dkb, dvb = lax.cond(
                 src < idx, fold, lambda a: a, (dq_acc, dkb, dvb)
             )
@@ -263,6 +293,7 @@ def ring_attention(
     remat: bool = False,
     use_flash: bool | None = None,
     interpret: bool = False,
+    layout: str = "contiguous",
 ) -> jax.Array:
     """Ring self-attention over a sharded sequence axis.
 
@@ -275,16 +306,25 @@ def ring_attention(
     overrides the auto-dispatch, ``interpret`` forces the Pallas
     interpreter for kernel tests off-TPU.
 
-    Causal mode skips fully-masked blocks outright (src > idx never
-    reaches the MXU — ~2× the ring's FLOPs saved), and the custom-VJP
+    Causal mode with the default ``layout="contiguous"`` skips
+    fully-masked blocks outright (src > idx never reaches the MXU — ~2×
+    the ring's FLOPs saved); ``layout="striped"`` instead interprets the
+    local shard as tokens {t : t mod W == device} (the caller permutes the
+    sequence accordingly — ``ContextParallel(layout="striped")`` does)
+    and every block becomes a balanced triangular tile, fixing the
+    contiguous layout's last-device latency bottleneck. The custom-VJP
     backward runs a second ring revolution with the flash decomposition
     (global lse/Δ), storing no per-tick residuals; ``remat`` is therefore
     implied and the flag is accepted for API compatibility.
     """
     del remat  # the custom-VJP backward always recomputes (flash-style)
+    if layout not in ("contiguous", "striped"):
+        raise ValueError(f"unknown ring layout {layout!r}")
     if use_flash is None:
         use_flash = jax.default_backend() == "tpu"
-    return _ring_attn(axis_name, causal, (use_flash, interpret), q, k, v)
+    return _ring_attn(
+        axis_name, causal, (use_flash, interpret, layout == "striped"), q, k, v
+    )
 
 
 def ulysses_attention(
@@ -309,6 +349,21 @@ def ulysses_attention(
     )
     o = dot_product_attention(qg, kg, vg, causal=causal)
     return all_to_all(o, axis_name, split_axis=1, concat_axis=2)
+
+
+def _stripe_time(x, world):
+    """Contiguous [B, T, ...] → striped: shard-slice i holds tokens
+    {t : t mod world == i} in order (host-side reorder; the device_put
+    that follows hands each device exactly its stripe)."""
+    b, t = x.shape[:2]
+    tl = t // world
+    return x.reshape(b, tl, world, *x.shape[2:]).swapaxes(1, 2).reshape(x.shape)
+
+
+def _unstripe_time(x, world):
+    b, t = x.shape[:2]
+    tl = t // world
+    return x.reshape(b, world, tl, *x.shape[2:]).swapaxes(1, 2).reshape(x.shape)
 
 
 class ContextParallel:
@@ -336,7 +391,18 @@ class ContextParallel:
         batch_axis: str | None = None,
         rng_root: jax.Array | None = None,
         aux_loss_weight: float | None = None,
+        layout: str = "contiguous",
     ):
+        if layout not in ("contiguous", "striped"):
+            raise ValueError(f"unknown layout {layout!r}")
+        model_layout = getattr(model, "seq_layout", "contiguous")
+        if model_layout != layout:
+            raise ValueError(
+                f"engine layout {layout!r} != model seq_layout "
+                f"{model_layout!r}; build the model with seq_layout="
+                f"{layout!r} so positions/masks match the token placement"
+            )
+        self.layout = layout
         self.model = model
         self.optimizer = optimizer
         self.mesh = mesh
@@ -369,13 +435,22 @@ class ContextParallel:
         return P(self.batch_axis, self.axis_name)
 
     def make_forward(self) -> Callable:
-        fwd = shard_map_fn(
-            lambda params, x: self.model(params, x),
-            self.mesh,
-            in_specs=(P(), self._batch_spec()),
-            out_specs=self._batch_spec(),
+        fwd = jax.jit(
+            shard_map_fn(
+                lambda params, x: self.model(params, x),
+                self.mesh,
+                in_specs=(P(), self._batch_spec()),
+                out_specs=self._batch_spec(),
+            )
         )
-        return jax.jit(fwd)
+        if self.layout != "striped":
+            return fwd
+
+        def striped_fwd(params, x):
+            y = fwd(params, _stripe_time(jnp.asarray(x), self.world))
+            return _unstripe_time(y, self.world)
+
+        return striped_fwd
 
     def _mean_axes(self) -> tuple[str, ...]:
         # One fused all-reduce over the combined (seq[, data]) group.
@@ -390,13 +465,23 @@ class ContextParallel:
         compiled program."""
         if self._eval_step is None:
             spec = self._batch_spec()
-            self._eval_step = make_counting_eval_step(
+            inner = make_counting_eval_step(
                 self.model, self.mesh, (P(), P(), spec, spec), self._mean_axes()
             )
+            if self.layout == "striped":
+                world = self.world
+                self._eval_step = jax.jit(
+                    lambda p, s, x, y: inner(
+                        p, s, _stripe_time(x, world), _stripe_time(y, world)
+                    )
+                )
+            else:
+                self._eval_step = inner
         return self._eval_step
 
     def evaluate(self, ts: TrainState, loader) -> float:
-        """Token-level top-1 accuracy over a loader of (tokens, labels)."""
+        """Token-level top-1 accuracy over a loader of (tokens, labels);
+        striping (when configured) happens inside the compiled eval step."""
         return evaluate_counts(self.make_eval_step(), ts, loader)
 
     def make_train_step(self) -> Callable:
@@ -434,17 +519,27 @@ class ContextParallel:
             return new_ts, metrics
 
         spec = self._batch_spec()
+        sharded = shard_map_fn(
+            spmd,
+            self.mesh,
+            in_specs=(P(), spec, spec),
+            out_specs=(P(), P()),
+        )
+        striped = self.layout == "striped"
+        world = self.world
+
+        def outer(ts: TrainState, tokens, labels):
+            if striped:
+                # Reorder INSIDE the jit (fused by XLA with the embedding
+                # gather) so the contiguous shard-slices the in_spec hands
+                # out ARE the stripes (token t mod W).
+                tokens = _stripe_time(tokens, world)
+                labels = _stripe_time(labels, world)
+            return sharded(ts, tokens, labels)
+
         # Donate the TrainState: replicated params/opt-state update in place.
         # Input state is CONSUMED; callers must rebind ts every step.
-        jitted = jax.jit(
-            shard_map_fn(
-                spmd,
-                self.mesh,
-                in_specs=(P(), spec, spec),
-                out_specs=(P(), P()),
-            ),
-            donate_argnums=(0,),
-        )
+        jitted = jax.jit(outer, donate_argnums=(0,))
 
         def step(ts: TrainState, tokens, labels):
             out = jitted(ts, jnp.asarray(tokens), jnp.asarray(labels))
