@@ -122,6 +122,8 @@ def build_engine(args, devices):
     rng_root = jax.random.key(args.seed ^ 0xD0) if args.dropout else None
     if args.parallel not in ("cp",) and args.attn in ("ring", "ulysses"):
         raise ValueError(f"--attn {args.attn} requires --parallel cp")
+    if args.cp_layout != "contiguous" and args.parallel != "cp":
+        raise ValueError("--cp_layout striped requires --parallel cp")
     if args.parallel == "ep":
         # MoE decoder trained expert-parallel: tokens + experts share the
         # expert axis, capacity buffers move by all_to_all.

@@ -377,3 +377,33 @@ def test_context_parallel_layout_mismatch_rejected(mesh):
                        num_layers=1, impl="ring", seq_sharded=True)
     with pytest.raises(ValueError, match="seq_layout"):
         ContextParallel(lm, make_optimizer("adam", 0.01), mesh, layout="striped")
+
+
+def test_striped_composes_with_gqa_and_rope(mesh):
+    """Feature interaction: striped layout × GQA (kv groups) × RoPE
+    (strided positions) through the full model — still matches the
+    contiguous run step for step."""
+    from tpudml.core.prng import seed_key
+    from tpudml.data.datasets import synthetic_lm
+    from tpudml.optim import make_optimizer
+
+    seqs = jnp.asarray(synthetic_lm(4, 33, 32, seed=6))
+    x, y = seqs[:, :32], seqs[:, 1:33]
+
+    def run(layout):
+        lm = TransformerLM(
+            vocab_size=32, embed_dim=32, num_heads=4, num_kv_heads=2,
+            num_layers=1, max_len=64, impl="ring", seq_sharded=True,
+            seq_layout=layout, rope=True,
+        )
+        eng = ContextParallel(lm, make_optimizer("adam", 0.01), mesh,
+                              layout=layout)
+        ts = eng.create_state(seed_key(7))
+        step = eng.make_train_step()
+        out = []
+        for _ in range(4):
+            ts, m = step(ts, x, y)
+            out.append(float(m["loss"]))
+        return out
+
+    np.testing.assert_allclose(run("striped"), run("contiguous"), rtol=2e-4)

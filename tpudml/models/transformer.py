@@ -180,17 +180,11 @@ class TransformerEmbed(Module):
             )
         h = params["tok_embed"][tokens]
         if self.use_pos_embed:
-            if not self.seq_sharded:
-                positions = jnp.arange(t_local)
-            elif self.seq_layout == "striped":
-                world = lax.axis_size(self.axis_name)
-                positions = lax.axis_index(self.axis_name) + world * jnp.arange(
-                    t_local
-                )
-            else:
-                positions = lax.axis_index(self.axis_name) * t_local + jnp.arange(
-                    t_local
-                )
+            from tpudml.nn.attention import sharded_positions
+
+            positions = sharded_positions(
+                self.axis_name, t_local, self.seq_sharded, self.seq_layout
+            )
             h = h + params["pos_embed"][positions]
         return h, state
 

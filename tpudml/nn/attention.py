@@ -27,6 +27,21 @@ from tpudml.nn.layers import Dense, Module
 NEG_INF = -1e30  # large-finite mask value: avoids inf-inf → NaN in softmax
 
 
+def sharded_positions(
+    axis_name: str, t_local: int, seq_sharded: bool, seq_layout: str
+) -> jax.Array:
+    """GLOBAL token positions of this device's [t_local] sequence shard —
+    the ONE definition RoPE, the position table, and the ring masks all
+    derive from (a divergence between them is silent model corruption):
+    contiguous → idx·Tl + j; striped → idx + W·j; unsharded → j."""
+    if not seq_sharded:
+        return jnp.arange(t_local)
+    if seq_layout == "striped":
+        world = jax.lax.axis_size(axis_name)
+        return jax.lax.axis_index(axis_name) + world * jnp.arange(t_local)
+    return jax.lax.axis_index(axis_name) * t_local + jnp.arange(t_local)
+
+
 def rotary_embedding(
     x: jax.Array, positions: jax.Array, base: float = 10000.0
 ) -> jax.Array:
@@ -177,13 +192,9 @@ class MultiHeadAttention(Module):
         if self.rope:
             # Before the GQA repeat: rotating the kv_heads-wide tensor does
             # group× less work and repeating rotated heads is identical.
-            if not self.seq_sharded:
-                positions = jnp.arange(t)
-            elif self.seq_layout == "striped":
-                world = jax.lax.axis_size(self.axis_name)
-                positions = jax.lax.axis_index(self.axis_name) + world * jnp.arange(t)
-            else:
-                positions = jax.lax.axis_index(self.axis_name) * t + jnp.arange(t)
+            positions = sharded_positions(
+                self.axis_name, t, self.seq_sharded, self.seq_layout
+            )
             q = rotary_embedding(q, positions, self.rope_base)
             k = rotary_embedding(k, positions, self.rope_base)
         if self._kv_heads != self.num_heads:

@@ -446,9 +446,13 @@ class ContextParallel:
         if self.layout != "striped":
             return fwd
 
+        world = self.world
+
+        @jax.jit
         def striped_fwd(params, x):
-            y = fwd(params, _stripe_time(jnp.asarray(x), self.world))
-            return _unstripe_time(y, self.world)
+            # Stripe/unstripe inside the jit, consistent with the
+            # train/eval paths (fused by XLA, no eager pre-dispatch).
+            return _unstripe_time(fwd(params, _stripe_time(x, world)), world)
 
         return striped_fwd
 
