@@ -210,9 +210,11 @@ def test_three_process_task4_e2e(tmp_path):
 
 
 @pytest.mark.slow
-def test_two_process_task5_e2e(tmp_path):
-    """2-process task5 LM training (data-parallel over a cross-process
-    mesh): the long-context entrypoint's distributed path end-to-end."""
+@pytest.mark.parametrize("parallel", ["dp", "cp"])
+def test_two_process_task5_e2e(tmp_path, parallel):
+    """2-process task5 LM training end-to-end: dp = replicated model over
+    a cross-process data mesh; cp = ring-attention context parallelism
+    with K/V blocks ppermuting across REAL process boundaries."""
     import re
 
     sink = io.StringIO()
@@ -220,7 +222,7 @@ def test_two_process_task5_e2e(tmp_path):
         num_processes=2, timeout_s=420.0, rank_env=_one_device_env(2)
     )
     result = launch(
-        [PY, "-m", "tasks.task5_longcontext", "--parallel", "dp",
+        [PY, "-m", "tasks.task5_longcontext", "--parallel", parallel,
          "--seq_len", "32", "--batch_size", "8", "--vocab", "32",
          "--embed_dim", "32", "--num_heads", "4", "--num_layers", "1",
          "--steps", "30", "--lr", "0.01", "--log_every", "0"],
@@ -231,7 +233,7 @@ def test_two_process_task5_e2e(tmp_path):
     assert result.success, out
     losses = re.findall(r"final loss ([0-9.]+)", out)
     assert len(losses) == 2, out
-    assert len(set(losses)) == 1, losses  # replicas agree
+    assert len(set(losses)) == 1, losses  # ranks agree
     assert float(losses[0]) < 1.0, out  # learned the successor permutation
 
 
@@ -325,3 +327,4 @@ def test_tpu_vm_cli_dry_run(capsys):
     assert out.count("gcloud compute tpus tpu-vm") == 4
     assert "create pod1" in out and "delete pod1" in out
     assert "echo hi" in out
+

@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from tpudml.nn.attention import MultiHeadAttention
+from tpudml.nn.attention import MultiHeadAttention, sharded_positions
 from tpudml.nn.layers import Dense, LayerNorm, Module
 
 
@@ -180,8 +180,6 @@ class TransformerEmbed(Module):
             )
         h = params["tok_embed"][tokens]
         if self.use_pos_embed:
-            from tpudml.nn.attention import sharded_positions
-
             positions = sharded_positions(
                 self.axis_name, t_local, self.seq_sharded, self.seq_layout
             )
