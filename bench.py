@@ -1,20 +1,30 @@
 """Benchmark entrypoint (driver contract: prints ONE JSON line).
 
 Headline = the north-star metric (BASELINE.json): steady-state CIFAR-10
-ResNet-18 data-parallel training throughput in images/sec/chip, bfloat16
-compute on the MXU. A transformer-LM tokens/sec/chip secondary metric
-(task5's flagship model, flash attention on TPU) tracks the sequence
-workload too.
+ResNet-18 training throughput in images/sec/chip, bfloat16 compute on the
+MXU. A transformer-LM tokens/sec/chip secondary metric (task5's flagship
+model, flash attention on TPU) tracks the sequence workload too.
 
-Honesty notes (VERDICT round 1):
-- FLOPs/step come from XLA's compiled cost analysis of the single-chip
-  step (not hand-waving), and ``mfu`` = achieved FLOP/s over the chip's
-  bf16 peak.
-- The tunneled chip's wall-clock is protocol-relative (the relay can
-  overlap/elide dispatches), so MFU can exceed 1.0; ``mfu_artifact``
-  flags that case and ``vs_baseline`` must only ever be read as
-  bench.py-vs-its-own-prior-recording under the same protocol, never as
-  a real speedup claim.
+Three timing protocols (VERDICT round 2, item 1 — the honest clock):
+
+- ``fori`` (HEADLINE): K train steps inside ONE XLA dispatch via
+  ``lax.fori_loop``; the device cannot elide or overlap them, and the
+  measurement syncs by fetching the final loss to the host (a
+  device->host copy cannot complete before the value exists). Per-step
+  time is differenced between two trip counts, which cancels dispatch +
+  transfer overhead. This is the artifact-proof number: its MFU must be
+  <= 1.0 on working hardware.
+- ``synced``: one dispatch per step, host-fetching the loss every step.
+  Includes per-step dispatch/transfer latency — the lower bound a naive
+  eager-style loop would see.
+- ``pipelined`` (legacy, rounds 1-2 protocol): chained donated-state
+  dispatches, sync once at the end via ``block_until_ready``. Through
+  the tunneled relay this measured dispatch throughput, not compute
+  (r2: 18.2x "MFU") — kept only for continuity with prior recordings;
+  ``mfu_pipelined_artifact`` flags it independently when it exceeds peak.
+
+``mfu`` = flops_per_step (XLA compiled cost analysis of the single-chip
+step) / sec_per_step(fori) / chip bf16 peak.
 """
 
 from __future__ import annotations
@@ -59,8 +69,79 @@ def _compiled_flops(fn, *args) -> float | None:
         return None
 
 
-def _time_steps(step, ts, batch, iters):
-    """Steady-state seconds per step (post-warmup)."""
+def _fetch(x) -> float:
+    """Host materialization as the sync barrier. ``block_until_ready``
+    through the tunneled relay has been observed to return before the
+    device finishes (r2's >100%-of-peak artifact); a device->host copy of
+    the value itself cannot."""
+    return float(jax.device_get(x))
+
+
+def _make_step_body(model, optimizer):
+    """(ts, images, labels) -> (new_ts, loss): the real training step body
+    (shared with make_train_step, so the bench times what training runs)."""
+    from tpudml.train import make_train_step_body
+
+    step = make_train_step_body(model, optimizer)
+
+    def body(ts, images, labels):
+        new_ts, metrics = step(ts, images, labels)
+        return new_ts, metrics["loss"]
+
+    return body
+
+
+def _time_fori(body, ts, batch, k_lo, k_hi):
+    """Artifact-proof seconds/step: run K steps inside ONE dispatch, sync by
+    fetching the final loss, difference two trip counts to cancel the
+    constant dispatch + transfer overhead. ``k`` is a dynamic argument so
+    both trip counts share one compiled program."""
+
+    @jax.jit
+    def run(ts, images, labels, k):
+        def one(_, carry):
+            ts, _ = carry
+            return body(ts, images, labels)
+
+        return jax.lax.fori_loop(0, k, one, (ts, jnp.zeros((), jnp.float32)))
+
+    images, labels = batch
+
+    def timed(k) -> float:
+        t0 = time.perf_counter()
+        _, loss = run(ts, images, labels, k)
+        _fetch(loss)
+        return time.perf_counter() - t0
+
+    timed(2)  # compile + warm
+    # Symmetric sampling (min of 2 each) so a one-off tunnel hiccup on
+    # either trip count cannot bias or sign-flip the difference.
+    t_lo = min(timed(k_lo) for _ in range(2))
+    t_hi = min(timed(k_hi) for _ in range(2))
+    if t_hi <= t_lo:
+        # Degenerate measurement (jitter swamped the spread): fall back to
+        # the k_hi run including overhead — an upper bound on sec/step,
+        # never a garbage near-zero headline.
+        return t_hi / k_hi
+    return (t_hi - t_lo) / (k_hi - k_lo)
+
+
+def _time_synced(step, ts, batch, iters):
+    """One dispatch per step, host sync (loss fetch) every step."""
+    for _ in range(3):
+        ts, m = step(ts, *batch)
+        _fetch(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        ts, m = step(ts, *batch)
+        _fetch(m["loss"])
+    return (time.perf_counter() - t0) / iters
+
+
+def _time_pipelined(step, ts, batch, iters):
+    """Rounds 1-2 protocol: chained donated-state dispatches, one sync at
+    the end. Protocol-relative through the tunneled relay (see module
+    docstring) — NOT the headline."""
     for _ in range(3):
         ts, m = step(ts, *batch)
     jax.block_until_ready(m["loss"])
@@ -71,18 +152,28 @@ def _time_steps(step, ts, batch, iters):
     return (time.perf_counter() - t0) / iters
 
 
-def _mfu_fields(flops_per_step, sec_per_step, peak):
-    if not flops_per_step or not peak:
-        return {}
-    mfu = flops_per_step / sec_per_step / peak
-    return {
-        "flops_per_step": round(flops_per_step),
-        "mfu": round(mfu, 4),
-        # >100% of peak is physically impossible: the tunneled chip's
-        # relay overlapped/elided dispatches and the timing is a protocol
-        # artifact, not a throughput claim.
-        "mfu_artifact": bool(mfu > 1.0),
+def _mfu_fields(flops_per_step, sec_fori, sec_synced, sec_pipelined, peak):
+    fields = {
+        "sec_per_step": round(sec_fori, 6),
+        "sec_per_step_synced": round(sec_synced, 6),
+        "sec_per_step_pipelined": round(sec_pipelined, 6),
+        "protocol": "fori",
     }
+    if flops_per_step and peak:
+        mfu = flops_per_step / sec_fori / peak
+        mfu_pipe = flops_per_step / sec_pipelined / peak
+        fields.update(
+            flops_per_step=round(flops_per_step),
+            mfu=round(mfu, 4),
+            # The fori protocol cannot exceed peak on working hardware; a
+            # True here means the measurement itself is broken.
+            mfu_artifact=bool(mfu > 1.0),
+            mfu_pipelined=round(mfu_pipe, 4),
+            # The pipelined protocol CAN exceed peak through the relay
+            # (r2's 18x) — flagged independently of the headline.
+            mfu_pipelined_artifact=bool(mfu_pipe > 1.0),
+        )
+    return fields
 
 
 def bench_resnet(on_tpu: bool, n_devices: int) -> dict:
@@ -93,38 +184,54 @@ def bench_resnet(on_tpu: bool, n_devices: int) -> dict:
     from tpudml.models import ResNet18
     from tpudml.optim import make_optimizer
     from tpudml.parallel.dp import DataParallel
-    from tpudml.train import TrainState, make_train_step
+    from tpudml.train import TrainState
 
     # 1024/chip keeps the MXU fed and amortizes dispatch; fits v5e HBM
-    # comfortably for CIFAR-sized inputs.
-    per_chip_batch = 1024 if on_tpu else 32
+    # comfortably for CIFAR-sized inputs. CPU dev mode stays tiny: XLA CPU
+    # executes conv bodies inside while-loops ~25x slower than the plain
+    # step (observed 30.8 vs 1.25 s/step at batch 16), so the fori smoke
+    # must be minimal there.
+    per_chip_batch = 1024 if on_tpu else 8
     batch = per_chip_batch * n_devices
     images, labels = synthetic_classification(batch, (32, 32, 3), 10, seed=0)
     images, labels = jnp.asarray(images), jnp.asarray(labels)
 
     model = ResNet18(compute_dtype=jnp.bfloat16 if on_tpu else jnp.float32)
     opt = make_optimizer("sgd", 0.1, momentum=0.9)
+
+    # Headline clock: single-chip step body under fori (what imgs/sec/CHIP
+    # and MFU measure; the DP collective is timed by the pipelined path).
+    chip_batch = (images[:per_chip_batch], labels[:per_chip_batch])
+    body = _make_step_body(model, opt)
+    ts0 = TrainState.create(model, opt, seed_key(0))
+    sec_fori = _time_fori(body, ts0, chip_batch, *((8, 40) if on_tpu else (1, 3)))
+
+    step1 = jax.jit(body)
+    sec_synced = _time_synced(
+        lambda ts, x, y: (lambda o: (o[0], {"loss": o[1]}))(step1(ts, x, y)),
+        ts0, chip_batch, 10 if on_tpu else 2,
+    )
+
     mesh = make_mesh(MeshConfig(axes={"data": n_devices}), jax.devices())
     dp = DataParallel(model, opt, mesh, stacked_batches=False)
-    sec = _time_steps(
+    sec_pipe = _time_pipelined(
         dp.make_train_step(), dp.create_state(seed_key(0)),
-        (images, labels), 30 if on_tpu else 5,
+        (images, labels), 30 if on_tpu else 3,
     )
 
     # FLOPs from the single-chip step on the per-chip batch (what each
     # chip executes; collectives excluded, matching the per-chip metric).
-    flops = _compiled_flops(
-        make_train_step(model, opt),
-        TrainState.create(model, opt, seed_key(0)),
-        images[:per_chip_batch],
-        labels[:per_chip_batch],
-    )
-    per_chip = batch / sec / max(n_devices, 1)
+    # ts0 is safe to pass: step1 does not donate and lowering executes
+    # nothing.
+    flops = _compiled_flops(step1, ts0, *chip_batch)
     return {
         "metric": "cifar10_resnet18_train_imgs_per_sec_per_chip",
-        "value": round(per_chip, 1),
+        "value": round(per_chip_batch / sec_fori, 1),
         "unit": "imgs/sec/chip",
-        **_mfu_fields(flops, sec, _peak_flops(jax.devices()[0])),
+        "value_synced": round(per_chip_batch / sec_synced, 1),
+        "value_pipelined": round(batch / sec_pipe / max(n_devices, 1), 1),
+        **_mfu_fields(flops, sec_fori, sec_synced, sec_pipe,
+                      _peak_flops(jax.devices()[0])),
     }
 
 
@@ -155,18 +262,30 @@ def bench_transformer(on_tpu: bool) -> dict:
     seqs = jnp.asarray(synthetic_lm(batch, seq_len + 1, cfg["vocab_size"], seed=1))
     x, y = seqs[:, :-1], seqs[:, 1:]
 
-    step = make_train_step(model, opt)
-    ts = TrainState.create(model, opt, seed_key(0))
-    sec = _time_steps(step, ts, (x, y), 20 if on_tpu else 5)
-    flops = _compiled_flops(
-        step, TrainState.create(model, opt, seed_key(0)), x, y,
+    body = _make_step_body(model, opt)
+    ts0 = TrainState.create(model, opt, seed_key(0))
+    sec_fori = _time_fori(body, ts0, (x, y), *((8, 40) if on_tpu else (1, 3)))
+
+    step1 = jax.jit(body)
+    sec_synced = _time_synced(
+        lambda ts, a, b: (lambda o: (o[0], {"loss": o[1]}))(step1(ts, a, b)),
+        ts0, (x, y), 10 if on_tpu else 2,
     )
+    step = make_train_step(model, opt)
+    sec_pipe = _time_pipelined(
+        step, TrainState.create(model, opt, seed_key(0)), (x, y),
+        20 if on_tpu else 3,
+    )
+    flops = _compiled_flops(step1, ts0, x, y)
     tokens = batch * seq_len
     return {
         "metric": "transformer_lm_train_tokens_per_sec_per_chip",
-        "value": round(tokens / sec, 1),
+        "value": round(tokens / sec_fori, 1),
         "unit": "tokens/sec/chip",
-        **_mfu_fields(flops, sec, _peak_flops(jax.devices()[0])),
+        "value_synced": round(tokens / sec_synced, 1),
+        "value_pipelined": round(tokens / sec_pipe, 1),
+        **_mfu_fields(flops, sec_fori, sec_synced, sec_pipe,
+                      _peak_flops(jax.devices()[0])),
     }
 
 
@@ -181,9 +300,10 @@ def main() -> None:
     baseline = None
     try:
         with open("BASELINE.json") as f:
-            baseline = json.load(f).get("published", {}).get(
-                "cifar10_resnet18_imgs_per_sec_per_chip"
-            )
+            pub = json.load(f).get("published", {})
+            # Honest-protocol pin if recorded; the legacy pipelined pin is
+            # protocol-incompatible with the fori headline.
+            baseline = pub.get("cifar10_resnet18_imgs_per_sec_per_chip_fori")
     except Exception:
         pass
     vs = headline["value"] / baseline if baseline else 1.0
@@ -191,8 +311,8 @@ def main() -> None:
         json.dumps(
             {
                 **headline,
-                # Protocol-relative: same-protocol bench.py recordings
-                # only — NOT a hardware speedup claim (see module note).
+                # fori-protocol recordings only (see module docstring);
+                # 1.0 until an honest pin exists in BASELINE.json.
                 "vs_baseline": round(vs, 3),
                 "secondary": secondary,
             }

@@ -170,7 +170,7 @@ def accumulate_grads(
     return grads, model_state, {"loss": loss_sum * inv, "accuracy": acc_sum * inv}
 
 
-def make_train_step(
+def make_train_step_body(
     model: Module,
     optimizer: Optimizer,
     rng_root: jax.Array | None = None,
@@ -178,18 +178,12 @@ def make_train_step(
     loss: Callable = softmax_cross_entropy,
     aux_loss_weight: float | None = None,
 ) -> Callable:
-    """Jitted single-device train step: grad + optimizer update fused into
-    one XLA program. ``rng_root`` (optional) seeds per-step dropout keys,
-    folded with the step counter inside the program; ``accum_steps``
-    splits the batch into sequential micro-batches (gradient
-    accumulation) to trade step latency for activation memory.
-    ``aux_loss_weight`` defaults on (α=0.01) for MoE-bearing models."""
+    """Un-jitted (ts, images, labels) -> (new_ts, metrics) step body —
+    the traceable core of :func:`make_train_step`, composable under
+    ``lax.fori_loop``/``lax.scan`` (bench.py times K of these inside one
+    dispatch)."""
     loss_fn = make_loss_fn(model, loss, resolve_aux_loss_weight(model, aux_loss_weight))
 
-    # Donated TrainState: in-place parameter/optimizer buffers (halves
-    # their HBM traffic). The input state is CONSUMED on every backend —
-    # callers must rebind ts on each step.
-    @partial(jax.jit, donate_argnums=(0,))
     def step(ts: TrainState, images, labels):
         rng = None if rng_root is None else jax.random.fold_in(rng_root, ts.step)
         grads, model_state, metrics = accumulate_grads(
@@ -205,6 +199,30 @@ def make_train_step(
         return new_ts, metrics
 
     return step
+
+
+def make_train_step(
+    model: Module,
+    optimizer: Optimizer,
+    rng_root: jax.Array | None = None,
+    accum_steps: int = 1,
+    loss: Callable = softmax_cross_entropy,
+    aux_loss_weight: float | None = None,
+) -> Callable:
+    """Jitted single-device train step: grad + optimizer update fused into
+    one XLA program. ``rng_root`` (optional) seeds per-step dropout keys,
+    folded with the step counter inside the program; ``accum_steps``
+    splits the batch into sequential micro-batches (gradient
+    accumulation) to trade step latency for activation memory.
+    ``aux_loss_weight`` defaults on (α=0.01) for MoE-bearing models.
+
+    Donated TrainState: in-place parameter/optimizer buffers (halves
+    their HBM traffic). The input state is CONSUMED on every backend —
+    callers must rebind ts on each step."""
+    body = make_train_step_body(
+        model, optimizer, rng_root, accum_steps, loss, aux_loss_weight
+    )
+    return jax.jit(body, donate_argnums=(0,))
 
 
 @lru_cache(maxsize=64)
