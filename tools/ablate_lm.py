@@ -1,0 +1,66 @@
+"""Transformer-LM step-time ablations on the real chip (fori protocol).
+
+The tunneled relay cannot serve ``jax.profiler`` traces, so component
+costs are measured by differencing whole-step times across model/config
+ablations (vocab size, attention impl, batch, head count). Used to drive
+the round-3 MFU tuning recorded in BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, ".")
+from bench import _make_step_body, _time_fori, _compiled_flops, _peak_flops  # noqa: E402
+
+from tpudml.core.prng import seed_key
+from tpudml.data.datasets import synthetic_lm
+from tpudml.models import TransformerLM
+from tpudml.optim import make_optimizer
+from tpudml.train import TrainState
+
+
+def run(name, batch=8, seq_len=1024, vocab=32768, heads=8, layers=6,
+        dim=512, impl="flash", remat=False):
+    model = TransformerLM(
+        vocab_size=vocab, embed_dim=dim, num_heads=heads, num_layers=layers,
+        max_len=seq_len, impl=impl, rope=True, remat=remat,
+        compute_dtype=jnp.bfloat16,
+    )
+    opt = make_optimizer("adamw", 3e-4)
+    seqs = jnp.asarray(synthetic_lm(batch, seq_len + 1, vocab, seed=1))
+    x, y = seqs[:, :-1], seqs[:, 1:]
+    body = _make_step_body(model, opt)
+    ts0 = TrainState.create(model, opt, seed_key(0))
+    t0 = time.time()
+    sec = _time_fori(body, ts0, (x, y), 8, 24)
+    flops = _compiled_flops(jax.jit(body), ts0, x, y)
+    peak = _peak_flops(jax.devices()[0])
+    mfu = flops / sec / peak if flops and peak else float("nan")
+    tokens = batch * seq_len
+    print(
+        f"{name:34s} {sec*1e3:8.2f} ms/step  {tokens/sec:12.0f} tok/s  "
+        f"mfu {mfu:.3f}  ({time.time()-t0:.0f}s incl compile)",
+        flush=True,
+    )
+    return sec
+
+
+if __name__ == "__main__":
+    which = sys.argv[1:] or ["base", "tinyvocab", "fullattn", "b32", "h4"]
+    if "base" in which:
+        run("base 6L512d V32k B8 flash")
+    if "tinyvocab" in which:
+        run("V=512 (head+loss removed)", vocab=512)
+    if "fullattn" in which:
+        run("impl=full (no flash kernel)", impl="full")
+    if "b32" in which:
+        run("B=32", batch=32)
+    if "h4" in which:
+        run("heads=4 (dh=128)", heads=4)
+    if "b32v512" in which:
+        run("B=32 V=512", batch=32, vocab=512)

@@ -1,0 +1,151 @@
+"""Microbenchmarks for LM step components on the real chip (fori clock).
+
+Isolates: embedding gather+scatter-add backward, LayerNorm stack, RoPE,
+flash-attention kernel at several block sizes, and the head matmul+loss.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, ".")
+from bench import _fetch  # noqa: E402
+
+
+def time_fn(name, fn, *args, iters_lo=8, iters_hi=24):
+    """fori-protocol timing of fn(*args) -> pytree; carries a f32 scalar."""
+
+    @jax.jit
+    def run(args, k):
+        def one(_, carry):
+            s, args = carry
+            # Data-dependence on the carried runtime scalar so XLA's LICM
+            # cannot hoist the (otherwise loop-invariant) body out of the
+            # loop: adding s*1e-30 is numerically a no-op but opaque at
+            # compile time. Int inputs (token ids) pass through untouched.
+            eps = s * 1e-30
+            args = jax.tree.map(
+                lambda a: a + eps.astype(a.dtype)
+                if jnp.issubdtype(a.dtype, jnp.floating) else a,
+                args,
+            )
+            out = fn(*args)
+            s = sum(
+                jnp.sum(x).astype(jnp.float32)
+                for x in jax.tree.leaves(out)
+            )
+            return s, args
+
+        return jax.lax.fori_loop(0, k, one, (jnp.zeros((), jnp.float32), args))
+
+    def timed(k):
+        t0 = time.perf_counter()
+        s, _ = run(args, k)
+        _fetch(s)
+        return time.perf_counter() - t0
+
+    timed(2)
+    t_lo = min(timed(iters_lo) for _ in range(2))
+    t_hi = min(timed(iters_hi) for _ in range(2))
+    sec = (t_hi - t_lo) / (iters_hi - iters_lo) if t_hi > t_lo else t_hi / iters_hi
+    print(f"{name:46s} {sec*1e3:8.3f} ms", flush=True)
+    return sec
+
+
+B, T, H, D, V = 8, 1024, 8, 64, 32768
+d_model = H * D
+key = jax.random.PRNGKey(0)
+tokens = jax.random.randint(key, (B, T), 0, V)
+E = jax.random.normal(key, (V, d_model), jnp.bfloat16) * 0.02
+g_embed = jax.random.normal(key, (B, T, d_model), jnp.bfloat16)
+x = jax.random.normal(key, (B, T, d_model), jnp.bfloat16)
+qkv = jax.random.normal(key, (B, T, H, D), jnp.bfloat16)
+
+which = set(sys.argv[1:]) or {"embed", "ln", "flash", "head"}
+
+if "embed" in which:
+    # Forward gather alone.
+    time_fn("embed gather fwd", lambda E: E[tokens], E)
+
+    # Gather + backward (scatter-add) via vjp.
+    def embed_loss(E):
+        return jnp.sum(E[tokens].astype(jnp.float32) * g_embed.astype(jnp.float32))
+
+    time_fn("embed gather+scatter bwd (grad)", jax.grad(embed_loss), E)
+
+    # One-hot matmul formulation of the same gradient.
+    def embed_loss_onehot(E):
+        oh = jax.nn.one_hot(tokens.reshape(-1), V, dtype=jnp.bfloat16)
+        h = (oh @ E).reshape(B, T, d_model)
+        return jnp.sum(h.astype(jnp.float32) * g_embed.astype(jnp.float32))
+
+    time_fn("embed one-hot matmul fwd+bwd (grad)", jax.grad(embed_loss_onehot), E)
+
+if "ln" in which:
+    from tpudml.nn.layers import LayerNorm
+
+    ln = LayerNorm(d_model)
+    p, _ = ln.init(key)
+
+    def ln_stack(x):
+        h = x
+        for _ in range(12):  # 2 per block x 6 layers
+            h = ln(p, h)
+        return h
+
+    time_fn("12x LayerNorm fwd", ln_stack, x)
+    time_fn(
+        "12x LayerNorm fwd+bwd",
+        jax.grad(lambda x: jnp.sum(ln_stack(x).astype(jnp.float32))),
+        x,
+    )
+
+if "flash" in which:
+    from tpudml.ops.attention_kernel import flash_attention
+    from tpudml.nn.attention import dot_product_attention
+
+    for bq, bk in [(128, 512), (256, 512), (512, 512), (512, 1024), (128, 128)]:
+        time_fn(
+            f"flash fwd causal bq={bq} bk={bk}",
+            partial(flash_attention, causal=True, block_q=bq, block_k=bk),
+            qkv, qkv, qkv,
+        )
+        time_fn(
+            f"flash fwd+bwd causal bq={bq} bk={bk}",
+            jax.grad(
+                lambda q, k, v: jnp.sum(
+                    flash_attention(q, k, v, causal=True, block_q=bq, block_k=bk)
+                    .astype(jnp.float32)
+                ),
+                argnums=(0, 1, 2),
+            ),
+            qkv, qkv, qkv,
+        )
+    time_fn(
+        "xla full attn fwd+bwd causal",
+        jax.grad(
+            lambda q, k, v: jnp.sum(
+                dot_product_attention(q, k, v, causal=True).astype(jnp.float32)
+            ),
+            argnums=(0, 1, 2),
+        ),
+        qkv, qkv, qkv,
+    )
+
+if "head" in which:
+    from tpudml.nn.losses import softmax_cross_entropy
+
+    W = jax.random.normal(key, (d_model, V), jnp.bfloat16) * 0.02
+    y = jax.random.randint(key, (B, T), 0, V)
+
+    def head_loss(W, x):
+        logits = (x @ W).astype(jnp.float32)
+        return softmax_cross_entropy(logits.reshape(-1, V), y.reshape(-1))
+
+    time_fn("head matmul+xent fwd", head_loss, W, x)
+    time_fn("head matmul+xent fwd+bwd", jax.grad(head_loss, argnums=(0, 1)), W, x)

@@ -7,16 +7,62 @@ The reference uses ``nn.CrossEntropyLoss`` over logits + integer labels
 
 from __future__ import annotations
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 
 
+@jax.custom_vjp
 def softmax_cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
     """Mean softmax cross-entropy over integer labels (torch
-    CrossEntropyLoss semantics, reduction='mean')."""
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32), axis=-1)
-    return jnp.mean(nll)
+    CrossEntropyLoss semantics, reduction='mean').
+
+    Memory-lean custom VJP: the autodiff path would keep a full f32
+    ``log_softmax(logits)`` residual AND row-gather it (both expensive at
+    LM scale — [B·T, 32k] logits are ~1 GB in f32); here the forward
+    keeps only the per-row log-sum-exp (plus the logits it was handed),
+    the label pick is a fused where+sum instead of a TPU row-gather, and
+    the backward recomputes softmax in one fused pass. Statistics are f32
+    regardless of the logits dtype, so bf16 logits need no up-cast
+    materialization."""
+    loss, _ = _xent_fwd_value(logits, labels)
+    return loss
+
+
+def _label_mask(labels: jax.Array, shape) -> jax.Array:
+    """One-hot mask [..., V] via fused iota-compare (no TPU row-gather)."""
+    ids = jax.lax.broadcasted_iota(jnp.int32, shape, len(shape) - 1)
+    return ids == labels[..., None].astype(jnp.int32)
+
+
+def _xent_fwd_value(logits, labels):
+    f32 = jnp.float32
+    m = jnp.max(logits, axis=-1)  # bf16 max is exact under compare
+    shifted = logits.astype(f32) - m.astype(f32)[..., None]
+    lse = jnp.log(jnp.sum(jnp.exp(shifted), axis=-1)) + m.astype(f32)
+    picked = jnp.sum(
+        jnp.where(_label_mask(labels, logits.shape), logits, 0).astype(f32),
+        axis=-1,
+    )
+    return jnp.mean(lse - picked), lse
+
+
+def _xent_fwd(logits, labels):
+    loss, lse = _xent_fwd_value(logits, labels)
+    return loss, (logits, labels, lse)
+
+
+def _xent_bwd(res, g):
+    logits, labels, lse = res
+    n = lse.size  # number of rows averaged over
+    p = jnp.exp(logits.astype(jnp.float32) - lse[..., None])
+    onehot = _label_mask(labels, logits.shape)
+    dlogits = ((p - onehot.astype(jnp.float32)) * (g / n)).astype(logits.dtype)
+    return dlogits, np.zeros(labels.shape, dtype=jax.dtypes.float0)
+
+
+softmax_cross_entropy.defvjp(_xent_fwd, _xent_bwd)
 
 
 def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
