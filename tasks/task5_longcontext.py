@@ -67,6 +67,11 @@ def parse_args(argv=None) -> argparse.Namespace:
     )
     p.add_argument("--microbatches", type=int, default=4, help="pp micro-batches")
     p.add_argument(
+        "--pp_data", type=int, default=1,
+        help="pp only: data-parallel replicas composed with the pipeline "
+        "(2-D {data, stage} mesh; n_devices/pp_data stages per replica)",
+    )
+    p.add_argument(
         "--schedule", choices=["gpipe", "1f1b"], default="gpipe",
         help="pp schedule: gpipe (scan+AD) or 1f1b (interleaved, S-bounded "
         "activation memory, dropout-capable)",
@@ -188,7 +193,15 @@ def build_engine(args, devices):
         from tpudml.models import TransformerBlock, TransformerEmbed, TransformerHead
         from tpudml.parallel.pp import GPipe, OneFOneB
 
-        mesh = make_mesh(MeshConfig({"stage": n}), devices)
+        # --pp_data D composes the pipeline with data parallelism on a
+        # 2-D {data, stage} mesh: D replicas each pipeline n/D stages.
+        d = args.pp_data
+        if d < 1 or n % d:
+            raise ValueError(f"--pp_data {d} must be >= 1 and divide n_devices {n}")
+        if d > 1:
+            mesh = make_mesh(MeshConfig({"data": d, "stage": n // d}), devices)
+        else:
+            mesh = make_mesh(MeshConfig({"stage": n}), devices)
         common = dict(
             n_microbatches=args.microbatches,
             mesh=mesh,
@@ -198,6 +211,7 @@ def build_engine(args, devices):
                 use_pos_embed=not args.rope,
             ),
             epilogue=TransformerHead(args.embed_dim, args.vocab),
+            batch_axis="data" if d > 1 else None,
         )
         block = TransformerBlock(
             args.embed_dim, args.num_heads, causal=True, impl=impl,

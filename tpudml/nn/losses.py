@@ -31,9 +31,16 @@ def softmax_cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
 
 
 def _label_mask(labels: jax.Array, shape) -> jax.Array:
-    """One-hot mask [..., V] via fused iota-compare (no TPU row-gather)."""
+    """One-hot mask [..., V] via fused iota-compare (no TPU row-gather).
+
+    Labels are clamped to [0, V-1] — the same semantics the previous
+    ``take_along_axis`` implementation had under jit (XLA clamps
+    out-of-range gathers), so invalid ids map to an edge class instead of
+    silently dropping their pull-up term. Torch-style ignore ids (-100)
+    are NOT supported; mask such rows out before the loss."""
     ids = jax.lax.broadcasted_iota(jnp.int32, shape, len(shape) - 1)
-    return ids == labels[..., None].astype(jnp.int32)
+    clamped = jnp.clip(labels.astype(jnp.int32), 0, shape[-1] - 1)
+    return ids == clamped[..., None]
 
 
 def _xent_fwd_value(logits, labels):

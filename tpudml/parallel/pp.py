@@ -33,7 +33,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tpudml.comm.collectives import ppermute_ring, psum_tree
+from tpudml.comm.collectives import pmean_tree, ppermute_ring, psum_tree
 from tpudml.nn.layers import Module
 from tpudml.nn.losses import accuracy, softmax_cross_entropy
 from tpudml.optim import Optimizer, shard_aware_clip
@@ -115,6 +115,14 @@ class GPipe:
     replicated modules run before/after the pipelined trunk (their redundant
     compute is the standard trade for keeping them out of the schedule).
     Blocks must be shape-preserving and stateless (no BatchNorm).
+
+    PP×DP composition: on a 2-D ``{"data": D, "stage": S}`` mesh, pass
+    ``batch_axis="data"`` — the global batch shards over ``data`` (each
+    data-replica pipelines its own shard through the same per-stage
+    params, which are replicated over ``data`` by construction), and
+    gradients/metrics are ``pmean``-ed over ``data`` before the optimizer
+    so replicas stay bitwise in sync. Same composition contract as
+    CP×DP (``parallel/cp.py``) and the GSPMD engine's ``batch_axis``.
     """
 
     def __init__(
@@ -128,6 +136,7 @@ class GPipe:
         epilogue: Module | None = None,
         loss: Callable = softmax_cross_entropy,
         remat: bool = False,
+        batch_axis: str | None = None,
     ):
         self.block = block
         self.remat = remat
@@ -149,10 +158,21 @@ class GPipe:
         )
         self.axis_name = axis_name
         self.n_stages = mesh.shape[axis_name]
+        self.batch_axis = batch_axis
+        if batch_axis is not None and batch_axis not in mesh.shape:
+            raise ValueError(
+                f"batch_axis {batch_axis!r} is not an axis of the mesh "
+                f"{dict(mesh.shape)}"
+            )
         self.prologue = prologue
         self.epilogue = epilogue
         self.loss = loss
         self._throttle = DispatchThrottle(mesh)
+
+    def _batch_spec(self) -> P:
+        """Spec for batch-shaped arrays: sharded over the data axis when
+        composing with DP, replicated otherwise."""
+        return P(self.batch_axis) if self.batch_axis else P()
 
     # ---------------------------------------------------------------- params
 
@@ -274,8 +294,8 @@ class GPipe:
         fwd = shard_map_fn(
             self._pipe_body,
             self.mesh,
-            in_specs=(self.param_specs(), P()),
-            out_specs=P(),
+            in_specs=(self.param_specs(), self._batch_spec()),
+            out_specs=self._batch_spec(),
         )
         return jax.jit(fwd)
 
@@ -298,8 +318,16 @@ class GPipe:
         # Epilogue gradients are computed identically on every device
         # (replicated input, replicated params) — no collective needed.
         grads = dict(grads, prologue=psum_tree(grads["prologue"], axis))
-        new_params, new_opt = self.optimizer.update(grads, ts.opt_state, ts.params)
         metrics = {"loss": loss, "accuracy": accuracy(logits, labels)}
+        if self.batch_axis:
+            # DP composition: every data-replica pipelined a different
+            # batch shard; averaging grads = grad of the global-batch mean
+            # loss (each replica's loss is already its shard mean).
+            grads = pmean_tree(grads, self.batch_axis)
+            metrics = {
+                k: lax.pmean(v, self.batch_axis) for k, v in metrics.items()
+            }
+        new_params, new_opt = self.optimizer.update(grads, ts.opt_state, ts.params)
         new_ts = TrainState(
             params=new_params,
             model_state=ts.model_state,
@@ -323,7 +351,7 @@ class GPipe:
             shard_map_fn(
                 self._spmd_step,
                 self.mesh,
-                in_specs=(specs, P(), P()),
+                in_specs=(specs, self._batch_spec(), self._batch_spec()),
                 out_specs=(specs, P()),
             ),
             donate_argnums=(0,),
@@ -421,7 +449,12 @@ class OneFOneB(GPipe):
         def key_for(m):
             if step_key is None:
                 return None
-            return jax.random.fold_in(jax.random.fold_in(step_key, stage), m)
+            key = jax.random.fold_in(jax.random.fold_in(step_key, stage), m)
+            if self.batch_axis:
+                # Decorrelate dropout masks across data replicas (each
+                # sees a different batch shard) — DataParallel's contract.
+                key = jax.random.fold_in(key, lax.axis_index(self.batch_axis))
+            return key
 
         def run_block(p, xin, key):
             return self.block.apply(p, {}, xin, train=train, rng=key)[0]
@@ -541,11 +574,18 @@ class OneFOneB(GPipe):
             "stages": jax.tree.map(lambda g: g[None], g_st),
             "epilogue": psum_tree(g_epi, axis),
         }
-        new_params, new_opt = self.optimizer.update(grads, ts.opt_state, ts.params)
         metrics = {
             "loss": lax.psum(loss_sum, axis) / M,
             "accuracy": lax.psum(acc_sum, axis) / M,
         }
+        if self.batch_axis:
+            # PP×DP: average the per-data-replica pipeline grads/metrics
+            # (see GPipe._spmd_step).
+            grads = pmean_tree(grads, self.batch_axis)
+            metrics = {
+                k: lax.pmean(v, self.batch_axis) for k, v in metrics.items()
+            }
+        new_params, new_opt = self.optimizer.update(grads, ts.opt_state, ts.params)
         new_ts = TrainState(
             params=new_params,
             model_state=ts.model_state,
