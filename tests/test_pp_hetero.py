@@ -1,0 +1,143 @@
+"""Heterogeneous pipeline (HeteroPipeline): the task4 conv/fc split as
+TRUE micro-batched pipeline stages.
+
+Contract (VERDICT r2 item 4): stages with different block structures and
+different activation shapes — the reference's actual model-parallel
+workload, codes/task4/model.py:18-47 — pipeline with grad-exact parity
+vs the sequential chain. Params ravel into a padded [S, L] stage-sharded
+buffer; activations travel as padded flat buffers; lax.switch picks each
+device's stage apply.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpudml.core.config import MeshConfig
+from tpudml.core.dist import make_mesh
+from tpudml.core.prng import seed_key
+from tpudml.models.staged import lenet_stages
+from tpudml.nn import Activation, Dense, Sequential
+from tpudml.nn.losses import softmax_cross_entropy
+from tpudml.optim import make_optimizer
+from tpudml.parallel.pp import HeteroPipeline
+
+
+def lenet_pipe(n_mb=4, opt=None, n_data=1):
+    stages = [m for _, m in lenet_stages().stages]
+    if n_data > 1:
+        mesh = make_mesh(
+            MeshConfig({"data": n_data, "stage": 2}), jax.devices()[: 2 * n_data]
+        )
+    else:
+        mesh = make_mesh(MeshConfig({"stage": 2}), jax.devices()[:2])
+    return HeteroPipeline(
+        stages, n_microbatches=n_mb, mesh=mesh,
+        optimizer=opt or make_optimizer("sgd", 0.05, momentum=0.9),
+        batch_axis="data" if n_data > 1 else None,
+    )
+
+
+@pytest.fixture(scope="module")
+def batch():
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(16, 28, 28, 1)).astype(np.float32)
+    y = rng.integers(0, 10, size=(16,)).astype(np.int32)
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+@pytest.mark.parametrize("n_mb", [1, 2, 8])
+def test_lenet_forward_matches_sequential(batch, n_mb):
+    """n_mb=1 is exactly the reference's degenerate RPC pipeline regime."""
+    x, _ = batch
+    pipe = lenet_pipe(n_mb)
+    params = pipe.init_params(seed_key(0))
+    got = pipe.make_forward()(params, x)
+    want = pipe.sequential_forward(params, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-6)
+
+
+def test_lenet_train_step_matches_single_device(batch):
+    x, y = batch
+    opt = make_optimizer("sgd", 0.05, momentum=0.9)
+    pipe = lenet_pipe(4, opt=opt)
+    ts = pipe.create_state(seed_key(1))
+    params0 = jax.device_get(ts.params)
+
+    new_ts, metrics = pipe.make_train_step()(ts, x, y)
+
+    ref_loss = lambda p: softmax_cross_entropy(pipe.sequential_forward(p, x), y)
+    loss0, ref_grads = jax.value_and_grad(ref_loss)(params0)
+    ref_params, _ = opt.update(ref_grads, opt.init(params0), params0)
+
+    np.testing.assert_allclose(float(metrics["loss"]), float(loss0), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(new_ts.params), jax.tree.leaves(ref_params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6)
+
+
+def test_lenet_hetero_pp_x_dp(batch):
+    """2 stage × 2 data: hetero pipeline composes with DP — first update
+    equals single-device on the full global batch."""
+    x, y = batch
+    opt = make_optimizer("sgd", 0.05, momentum=0.9)
+    pipe = lenet_pipe(2, opt=opt, n_data=2)
+    ts = pipe.create_state(seed_key(1))
+    params0 = jax.device_get(ts.params)
+
+    new_ts, metrics = pipe.make_train_step()(ts, x, y)
+
+    ref_loss = lambda p: softmax_cross_entropy(pipe.sequential_forward(p, x), y)
+    loss0, ref_grads = jax.value_and_grad(ref_loss)(params0)
+    ref_params, _ = opt.update(ref_grads, opt.init(params0), params0)
+
+    np.testing.assert_allclose(float(metrics["loss"]), float(loss0), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(new_ts.params), jax.tree.leaves(ref_params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6)
+
+
+def test_four_uneven_mlp_stages():
+    """Four stages of *different* widths and param counts on 4 devices —
+    the general heterogeneous case beyond the 2-way reference split."""
+    stages = [
+        Sequential((Dense(12, 48), Activation(jax.nn.relu))),
+        Sequential((Dense(48, 20), Activation(jax.nn.relu))),
+        Sequential((Dense(20, 64), Activation(jax.nn.relu))),
+        Sequential((Dense(64, 10),)),
+    ]
+    mesh = make_mesh(MeshConfig({"stage": 4}), jax.devices()[:4])
+    opt = make_optimizer("adam", 1e-2)
+    pipe = HeteroPipeline(stages, n_microbatches=4, mesh=mesh, optimizer=opt)
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.normal(size=(8, 12)).astype(np.float32))
+    y = jnp.asarray(rng.integers(0, 10, size=(8,)).astype(np.int32))
+
+    params = pipe.init_params(seed_key(0))
+    got = pipe.make_forward()(params, x)
+    want = pipe.sequential_forward(params, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-6)
+
+    ts = pipe.create_state(seed_key(2))
+    step = pipe.make_train_step()
+    losses = []
+    for _ in range(30):
+        ts, m = step(ts, x, y)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0] * 0.5
+
+
+def test_validation_errors():
+    from tpudml.nn import BatchNorm, Dropout
+
+    mesh = make_mesh(MeshConfig({"stage": 2}), jax.devices()[:2])
+    opt = make_optimizer("sgd", 0.1)
+    with pytest.raises(ValueError, match="stages need"):
+        HeteroPipeline([Dense(4, 4)], 2, mesh, opt)
+    with pytest.raises(ValueError, match="dropout"):
+        HeteroPipeline(
+            [Sequential((Dense(4, 4), Dropout(0.5))), Dense(4, 4)], 2, mesh, opt
+        )
+    with pytest.raises(ValueError, match="stateful"):
+        HeteroPipeline(
+            [Sequential((Dense(4, 4), BatchNorm(4))), Dense(4, 4)], 2, mesh, opt
+        )

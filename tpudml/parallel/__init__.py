@@ -25,7 +25,7 @@ from tpudml.parallel.mp import (
     stage_sharding_rules,
     tensor_parallel_rules,
 )
-from tpudml.parallel.pp import GPipe, OneFOneB
+from tpudml.parallel.pp import GPipe, HeteroPipeline, OneFOneB
 
 __all__ = [
     "ContextParallel",
@@ -35,6 +35,7 @@ __all__ = [
     "FSDP",
     "fsdp_sharding_rules",
     "GPipe",
+    "HeteroPipeline",
     "OneFOneB",
     "GSPMDParallel",
     "ring_attention",

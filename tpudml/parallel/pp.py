@@ -15,10 +15,13 @@ tick, so with M micro-batches the bubble shrinks from (S-1)/S of the step
 needs no hand scheduling: AD transposes the scan and the ppermutes, which
 XLA schedules as the reverse ring.
 
-Scope: homogeneous stages — one ``block`` Module repeated S times with its
-parameters stacked on a leading stage axis (the idiomatic JAX/GSPMD layout;
-transformer decoders fit directly). Heterogeneous splits (the task4
-conv/fc split) stay on the GSPMD engine in ``tpudml.parallel.mp``.
+Scope: ``GPipe``/``OneFOneB`` run homogeneous stages — one ``block``
+Module repeated S times with its parameters stacked on a leading stage
+axis (the idiomatic JAX/GSPMD layout; transformer decoders fit directly).
+``HeteroPipeline`` (below) pipelines HETEROGENEOUS stages — the task4
+conv/fc split with different block structures and activation shapes —
+via padded stage-param ravel + ``lax.switch`` dispatch; the GSPMD engine
+in ``tpudml.parallel.mp`` remains the non-micro-batched alternative.
 Optimizer state lives sharded over the stage axis, so updates happen where
 the parameters live — the DistributedOptimizer contract
 (codes/task4/model.py:126) by construction.
@@ -26,10 +29,11 @@ the parameters live — the DistributedOptimizer contract
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Any, Callable, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -225,6 +229,26 @@ class GPipe:
 
     # --------------------------------------------------------------- forward
 
+    # Schedule hooks — overridden by HeteroPipeline (stage-dependent
+    # apply over padded flat buffers); GPipe runs the homogeneous block.
+
+    def _prep(self, params: PyTree, x: jax.Array) -> jax.Array:
+        """Full-local-batch input -> what enters the pipeline."""
+        if self.prologue is not None:
+            return self.prologue(params["prologue"], x)
+        return x
+
+    def _tick_apply(self, local: PyTree, inp: jax.Array, stage) -> jax.Array:
+        """One stage application at a tick (``stage`` = this device's
+        stage index, a traced scalar; homogeneous blocks ignore it)."""
+        return self.block(local, inp)
+
+    def _post(self, params: PyTree, y: jax.Array) -> jax.Array:
+        """Pipeline output -> logits."""
+        if self.epilogue is not None:
+            return self.epilogue(params["epilogue"], y)
+        return y
+
     def _pipe_body(self, params: PyTree, x: jax.Array) -> jax.Array:
         """Per-device pipeline forward (runs under shard_map; x replicated)."""
         axis, S, M = self.axis_name, self.n_stages, self.n_microbatches
@@ -233,9 +257,7 @@ class GPipe:
         # slice of the stacked stage axis.
         local = jax.tree.map(lambda p: p[0], params["stages"])
 
-        h = x
-        if self.prologue is not None:
-            h = self.prologue(params["prologue"], h)
+        h = self._prep(params, x)
         batch = h.shape[0]
         if batch % M:
             raise ValueError(f"batch {batch} not divisible by {M} microbatches")
@@ -261,7 +283,9 @@ class GPipe:
             # outbuf, so gradients are unchanged).
             live = (t >= stage) & (t - stage < M)
             out = lax.cond(
-                live, lambda: self.block(local, inp), lambda: jnp.zeros_like(inp)
+                live,
+                lambda: self._tick_apply(local, inp, stage),
+                lambda: jnp.zeros_like(inp),
             )
             # Last stage banks micro-batch t-(S-1) once the fill completes.
             valid = jnp.logical_and(stage == S - 1, t >= S - 1)
@@ -285,9 +309,7 @@ class GPipe:
         y = lax.psum(jnp.where(stage == S - 1, outbuf, jnp.zeros_like(outbuf)), axis)
         y = _grad_scale(y, 1.0 / S)
         y = y.reshape(batch, *y.shape[2:])
-        if self.epilogue is not None:
-            y = self.epilogue(params["epilogue"], y)
-        return y
+        return self._post(params, y)
 
     def make_forward(self) -> Callable:
         """Jitted full-batch pipeline forward: (params, x) -> logits."""
@@ -593,3 +615,188 @@ class OneFOneB(GPipe):
             step=ts.step + 1,
         )
         return new_ts, metrics
+
+
+class HeteroPipeline(GPipe):
+    """Micro-batched pipeline over HETEROGENEOUS stages — the reference's
+    actual model-parallel workload: a conv stage feeding an fc stage with
+    different block structures and different activation shapes
+    (codes/task4/model.py:18-47), pipelined with micro-batching instead of
+    the reference's blocking per-batch RPC round-trips.
+
+    SPMD needs every device to run one program on same-shaped buffers, so
+    heterogeneity is encoded as data, not control flow:
+
+    - **params**: each stage's param tree is raveled to a flat f32 vector,
+      zero-padded to the longest stage, and stacked [S, L] — sharded over
+      ``stage`` like GPipe's stacked homogeneous blocks. Elementwise
+      optimizers (SGD/momentum/Adam/AdamW — everything in tpudml.optim)
+      act identically on the raveled layout, and the padding lanes carry
+      zero gradients forever. Each device unravels only ITS stage's slice.
+    - **activations**: micro-batches travel as [B_micro, A] buffers with
+      A = max per-sample activation width over all stage boundaries; each
+      stage slices its input width, reshapes to its real input shape,
+      applies, and re-pads its output.
+    - **apply**: ``lax.switch`` over per-stage branches (each branch is
+      traced with its own static unravel/reshape structure); the device's
+      stage index picks the branch at run time. All S branches compile
+      per device — the price of SPMD heterogeneity, fine for the 2-4
+      stage splits this models.
+
+    Grad-exactness: the schedule, masking, psum broadcast, and 1/S grad
+    scale are inherited from GPipe unchanged, so the pipeline remains
+    mathematically the sequential chain of stages — pinned by parity
+    tests against ``sequential_forward`` and the single-device update.
+    Composes with data parallelism via ``batch_axis`` exactly like GPipe.
+    Stateless stages only; dropout needs the 1F1B engine (not offered for
+    hetero stages yet).
+    """
+
+    def __init__(
+        self,
+        stages: Sequence[Module],
+        n_microbatches: int,
+        mesh: Mesh,
+        optimizer: Optimizer | None = None,
+        axis_name: str = "stage",
+        loss: Callable = softmax_cross_entropy,
+        remat: bool = False,
+        batch_axis: str | None = None,
+    ):
+        if mesh.shape[axis_name] != len(stages):
+            raise ValueError(
+                f"{len(stages)} stages need a {len(stages)}-wide "
+                f"{axis_name!r} mesh axis, got {mesh.shape[axis_name]}"
+            )
+        super().__init__(
+            block=None,
+            n_microbatches=n_microbatches,
+            mesh=mesh,
+            optimizer=optimizer,
+            axis_name=axis_name,
+            loss=loss,
+            remat=remat,
+            batch_axis=batch_axis,
+        )
+        self.stages = tuple(stages)
+        for i, st in enumerate(self.stages):
+            if _has_dropout(st):
+                raise ValueError(
+                    f"stage {i} has dropout; hetero pipeline stages run "
+                    "without rng (no 1F1B hetero schedule yet)"
+                )
+        # Static per-stage param layout from abstract init: shapes via
+        # eval_shape (no device compute), ravel/unravel closures via
+        # ravel_pytree on host-side numpy zeros of those shapes.
+        from jax.flatten_util import ravel_pytree
+
+        self._param_shapes = []  # per-stage abstract param trees
+        self._unravels = []
+        self._stage_width = []
+        key = jax.random.PRNGKey(0)
+        for i, st in enumerate(self.stages):
+            p_shapes, s_shapes = jax.eval_shape(st.init, key)
+            if jax.tree.leaves(s_shapes):
+                raise ValueError(
+                    f"stage {i} is stateful (no BatchNorm in pipelines)"
+                )
+            zeros = jax.tree.map(
+                lambda l: np.zeros(l.shape, l.dtype), p_shapes
+            )
+            flat, unravel = ravel_pytree(zeros)
+            if flat.size and flat.dtype != jnp.float32:
+                raise ValueError(
+                    "hetero pipeline ravels stage params into one f32 "
+                    f"buffer; stage {i} ravels to {flat.dtype}"
+                )
+            self._param_shapes.append(p_shapes)
+            self._unravels.append(unravel)
+            self._stage_width.append(int(flat.size))
+        self._param_width = max(self._stage_width) if self._stage_width else 1
+        self._trace_plan = None  # set by _prep, read by _tick_apply/_post
+
+    # ------------------------------------------------------------- params
+
+    def _unravel(self, s: int, flat: jax.Array) -> PyTree:
+        return self._unravels[s](flat[: self._stage_width[s]])
+
+    def init_params(self, key: jax.Array) -> PyTree:
+        from jax.flatten_util import ravel_pytree
+
+        rows = []
+        for st, k in zip(self.stages, jax.random.split(key, len(self.stages))):
+            flat, _ = ravel_pytree(st.init(k)[0])
+            flat = flat.astype(jnp.float32) if flat.size else jnp.zeros((0,), jnp.float32)
+            rows.append(jnp.pad(flat, (0, self._param_width - flat.shape[0])))
+        return {
+            "prologue": {},
+            "stages": jnp.stack(rows),
+            "epilogue": {},
+        }
+
+    # -------------------------------------------------------- activations
+
+    def _io_plan(self, sample_shape, dtype):
+        """Static chain of per-SAMPLE IO shapes through the stages:
+        returns (sample shapes [input, out_0, ..., out_{S-1}], per-sample
+        widths, buffer width A = max width). Derived abstractly with
+        ``eval_shape`` — batch-size independent because stages are
+        per-sample maps (checked)."""
+        probe_b = 2  # avoid batch-1 broadcast ambiguities in the probe
+        shapes = [tuple(sample_shape)]
+        for i, st in enumerate(self.stages):
+            out = jax.eval_shape(
+                lambda p, xx, st=st: st(p, xx),
+                self._param_shapes[i],
+                jax.ShapeDtypeStruct((probe_b,) + shapes[-1], dtype),
+            )
+            if out.shape[0] != probe_b:
+                raise ValueError(
+                    f"stage {i} changed the batch dim "
+                    f"({probe_b} -> {out.shape[0]}); stages must be "
+                    "per-sample maps"
+                )
+            if out.dtype != dtype:
+                raise ValueError(
+                    f"stage {i} changed the activation dtype "
+                    f"({dtype} -> {out.dtype}); hetero buffers are "
+                    "single-dtype"
+                )
+            shapes.append(tuple(out.shape[1:]))
+        widths = [int(np.prod(s)) for s in shapes]
+        return shapes, widths, max(widths)
+
+    def _prep(self, params: PyTree, x: jax.Array) -> jax.Array:
+        # Raw input flattened per-sample and padded to the buffer width;
+        # the plan is stashed for _tick_apply/_post, which see only the
+        # shape-erased buffer (same trace: _prep runs first in _pipe_body).
+        self._trace_plan = self._io_plan(x.shape[1:], x.dtype)
+        _, _, a = self._trace_plan
+        flat = x.reshape(x.shape[0], -1)
+        return jnp.pad(flat, ((0, 0), (0, a - flat.shape[1])))
+
+    def _tick_apply(self, local: jax.Array, inp: jax.Array, stage) -> jax.Array:
+        bm = inp.shape[0]
+        shapes, widths, a = self._trace_plan
+
+        def branch(s):
+            def f(flat_in):
+                p = self._unravel(s, local)
+                xx = flat_in[:, : widths[s]].reshape((bm,) + shapes[s])
+                y = self.stages[s](p, xx)
+                yf = y.reshape(bm, -1)
+                return jnp.pad(yf, ((0, 0), (0, a - widths[s + 1])))
+
+            return f
+
+        return lax.switch(stage, [branch(s) for s in range(len(self.stages))], inp)
+
+    def _post(self, params: PyTree, y: jax.Array) -> jax.Array:
+        shapes, widths, _ = self._trace_plan
+        return y[:, : widths[-1]].reshape((y.shape[0],) + shapes[-1])
+
+    def sequential_forward(self, params: PyTree, x: jax.Array) -> jax.Array:
+        h = x
+        for s, st in enumerate(self.stages):
+            h = st(self._unravel(s, params["stages"][s]), h)
+        return h
