@@ -205,3 +205,40 @@ def test_dispatch_throttle_unit():
         th.after_step(v)
     assert th.max_pending_seen == 3
     assert len(th._pending) == 2  # window keeps max_in_flight - 1 after pop
+
+
+def test_dp_final_accuracy_matches_single_device(mesh):
+    """Quality-parity regression (VERDICT r2 item 6): trained to the same
+    budget at matched global batch and steps, DP must reach the same test
+    accuracy as single-device training — DP changes WHERE the math runs,
+    not what is learned. (The recorded task2/task3 pins train the full
+    60k-synthetic set to 99.9%; this is the fast in-suite version.)"""
+    from tpudml.nn.losses import accuracy as acc_fn
+
+    train_x, train_y = synthetic_classification(2048, (28, 28, 1), 10, seed=0,
+                                                proto_seed=100)
+    test_x, test_y = synthetic_classification(512, (28, 28, 1), 10, seed=1,
+                                              proto_seed=100)
+    test_x, test_y = jax.numpy.asarray(test_x), jax.numpy.asarray(test_y)
+    batch = 256
+    epochs = 3
+    model = LeNet()
+    accs = {}
+    for regime in ("single", "dp"):
+        opt = make_optimizer("adam", 2e-3)
+        if regime == "dp":
+            engine = DataParallel(model, opt, mesh, stacked_batches=False)
+            ts = engine.create_state(seed_key(0))
+            step = engine.make_train_step()
+        else:
+            ts = TrainState.create(model, opt, seed_key(0))
+            step = make_train_step(model, opt)
+        for _ in range(epochs):
+            for i in range(0, len(train_x), batch):
+                xb = jax.numpy.asarray(train_x[i:i + batch])
+                yb = jax.numpy.asarray(train_y[i:i + batch])
+                ts, _ = step(ts, xb, yb)
+        logits, _ = model.apply(ts.params, ts.model_state, test_x, train=False)
+        accs[regime] = float(acc_fn(logits, test_y))
+    assert accs["dp"] > 0.9, accs
+    assert abs(accs["dp"] - accs["single"]) < 0.02, accs

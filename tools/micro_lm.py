@@ -1,7 +1,8 @@
 """Microbenchmarks for LM step components on the real chip (fori clock).
 
-Isolates: embedding gather+scatter-add backward, LayerNorm stack, RoPE,
+Isolates: embedding gather+scatter-add backward, LayerNorm stack,
 flash-attention kernel at several block sizes, and the head matmul+loss.
+``time_fn`` is importable (tools/cp_balance.py reuses it).
 """
 
 from __future__ import annotations
@@ -57,95 +58,104 @@ def time_fn(name, fn, *args, iters_lo=8, iters_hi=24):
     return sec
 
 
-B, T, H, D, V = 8, 1024, 8, 64, 32768
-d_model = H * D
-key = jax.random.PRNGKey(0)
-tokens = jax.random.randint(key, (B, T), 0, V)
-E = jax.random.normal(key, (V, d_model), jnp.bfloat16) * 0.02
-g_embed = jax.random.normal(key, (B, T, d_model), jnp.bfloat16)
-x = jax.random.normal(key, (B, T, d_model), jnp.bfloat16)
-qkv = jax.random.normal(key, (B, T, H, D), jnp.bfloat16)
+def main():
+    B, T, H, D, V = 8, 1024, 8, 64, 32768
+    d_model = H * D
+    key = jax.random.PRNGKey(0)
+    tokens = jax.random.randint(key, (B, T), 0, V)
+    E = jax.random.normal(key, (V, d_model), jnp.bfloat16) * 0.02
+    g_embed = jax.random.normal(key, (B, T, d_model), jnp.bfloat16)
+    x = jax.random.normal(key, (B, T, d_model), jnp.bfloat16)
+    qkv = jax.random.normal(key, (B, T, H, D), jnp.bfloat16)
 
-which = set(sys.argv[1:]) or {"embed", "ln", "flash", "head"}
+    which = set(sys.argv[1:]) or {"embed", "ln", "flash", "head"}
 
-if "embed" in which:
-    # Forward gather alone.
-    time_fn("embed gather fwd", lambda E: E[tokens], E)
+    if "embed" in which:
+        time_fn("embed gather fwd", lambda E: E[tokens], E)
 
-    # Gather + backward (scatter-add) via vjp.
-    def embed_loss(E):
-        return jnp.sum(E[tokens].astype(jnp.float32) * g_embed.astype(jnp.float32))
+        def embed_loss(E):
+            return jnp.sum(
+                E[tokens].astype(jnp.float32) * g_embed.astype(jnp.float32)
+            )
 
-    time_fn("embed gather+scatter bwd (grad)", jax.grad(embed_loss), E)
+        time_fn("embed gather+scatter bwd (grad)", jax.grad(embed_loss), E)
 
-    # One-hot matmul formulation of the same gradient.
-    def embed_loss_onehot(E):
-        oh = jax.nn.one_hot(tokens.reshape(-1), V, dtype=jnp.bfloat16)
-        h = (oh @ E).reshape(B, T, d_model)
-        return jnp.sum(h.astype(jnp.float32) * g_embed.astype(jnp.float32))
+        def embed_loss_onehot(E):
+            oh = jax.nn.one_hot(tokens.reshape(-1), V, dtype=jnp.bfloat16)
+            h = (oh @ E).reshape(B, T, d_model)
+            return jnp.sum(h.astype(jnp.float32) * g_embed.astype(jnp.float32))
 
-    time_fn("embed one-hot matmul fwd+bwd (grad)", jax.grad(embed_loss_onehot), E)
-
-if "ln" in which:
-    from tpudml.nn.layers import LayerNorm
-
-    ln = LayerNorm(d_model)
-    p, _ = ln.init(key)
-
-    def ln_stack(x):
-        h = x
-        for _ in range(12):  # 2 per block x 6 layers
-            h = ln(p, h)
-        return h
-
-    time_fn("12x LayerNorm fwd", ln_stack, x)
-    time_fn(
-        "12x LayerNorm fwd+bwd",
-        jax.grad(lambda x: jnp.sum(ln_stack(x).astype(jnp.float32))),
-        x,
-    )
-
-if "flash" in which:
-    from tpudml.ops.attention_kernel import flash_attention
-    from tpudml.nn.attention import dot_product_attention
-
-    for bq, bk in [(128, 512), (256, 512), (512, 512), (512, 1024), (128, 128)]:
         time_fn(
-            f"flash fwd causal bq={bq} bk={bk}",
-            partial(flash_attention, causal=True, block_q=bq, block_k=bk),
-            qkv, qkv, qkv,
+            "embed one-hot matmul fwd+bwd (grad)", jax.grad(embed_loss_onehot), E
         )
+
+    if "ln" in which:
+        from tpudml.nn.layers import LayerNorm
+
+        ln = LayerNorm(d_model)
+        p, _ = ln.init(key)
+
+        def ln_stack(x):
+            h = x
+            for _ in range(12):  # 2 per block x 6 layers
+                h = ln(p, h)
+            return h
+
+        time_fn("12x LayerNorm fwd", ln_stack, x)
         time_fn(
-            f"flash fwd+bwd causal bq={bq} bk={bk}",
+            "12x LayerNorm fwd+bwd",
+            jax.grad(lambda x: jnp.sum(ln_stack(x).astype(jnp.float32))),
+            x,
+        )
+
+    if "flash" in which:
+        from tpudml.nn.attention import dot_product_attention
+        from tpudml.ops.attention_kernel import flash_attention
+
+        for bq, bk in [(128, 512), (256, 512), (512, 512), (512, 1024), (128, 128)]:
+            time_fn(
+                f"flash fwd causal bq={bq} bk={bk}",
+                partial(flash_attention, causal=True, block_q=bq, block_k=bk),
+                qkv, qkv, qkv,
+            )
+            time_fn(
+                f"flash fwd+bwd causal bq={bq} bk={bk}",
+                jax.grad(
+                    lambda q, k, v, bq=bq, bk=bk: jnp.sum(
+                        flash_attention(
+                            q, k, v, causal=True, block_q=bq, block_k=bk
+                        ).astype(jnp.float32)
+                    ),
+                    argnums=(0, 1, 2),
+                ),
+                qkv, qkv, qkv,
+            )
+        time_fn(
+            "xla full attn fwd+bwd causal",
             jax.grad(
                 lambda q, k, v: jnp.sum(
-                    flash_attention(q, k, v, causal=True, block_q=bq, block_k=bk)
-                    .astype(jnp.float32)
+                    dot_product_attention(q, k, v, causal=True).astype(jnp.float32)
                 ),
                 argnums=(0, 1, 2),
             ),
             qkv, qkv, qkv,
         )
-    time_fn(
-        "xla full attn fwd+bwd causal",
-        jax.grad(
-            lambda q, k, v: jnp.sum(
-                dot_product_attention(q, k, v, causal=True).astype(jnp.float32)
-            ),
-            argnums=(0, 1, 2),
-        ),
-        qkv, qkv, qkv,
-    )
 
-if "head" in which:
-    from tpudml.nn.losses import softmax_cross_entropy
+    if "head" in which:
+        from tpudml.nn.losses import softmax_cross_entropy
 
-    W = jax.random.normal(key, (d_model, V), jnp.bfloat16) * 0.02
-    y = jax.random.randint(key, (B, T), 0, V)
+        W = jax.random.normal(key, (d_model, V), jnp.bfloat16) * 0.02
+        y = jax.random.randint(key, (B, T), 0, V)
 
-    def head_loss(W, x):
-        logits = (x @ W).astype(jnp.float32)
-        return softmax_cross_entropy(logits.reshape(-1, V), y.reshape(-1))
+        def head_loss(W, x):
+            logits = (x @ W).astype(jnp.float32)
+            return softmax_cross_entropy(logits.reshape(-1, V), y.reshape(-1))
 
-    time_fn("head matmul+xent fwd", head_loss, W, x)
-    time_fn("head matmul+xent fwd+bwd", jax.grad(head_loss, argnums=(0, 1)), W, x)
+        time_fn("head matmul+xent fwd", head_loss, W, x)
+        time_fn(
+            "head matmul+xent fwd+bwd", jax.grad(head_loss, argnums=(0, 1)), W, x
+        )
+
+
+if __name__ == "__main__":
+    main()
