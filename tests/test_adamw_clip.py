@@ -130,3 +130,28 @@ def test_sharded_clip_matches_global_norm():
         np.testing.assert_allclose(
             np.asarray(sharded_out[k]), np.asarray(ref_out[k]), rtol=1e-6
         )
+
+
+def test_shard_aware_clip_recurses_into_wrapper_chains():
+    """ADVICE r2: a clip nested below the top of the optimizer chain must
+    be rewrapped too, or it would compute per-shard norms inside
+    shard_map. (Scheduled refuses a clip base at construction — the
+    reachable nesting is a clip under another clip, and the recursion
+    covers any future wrapper with a ``.base``.)"""
+    from tpudml.optim import shard_aware_clip
+
+    nested = ClipByGlobalNorm(
+        max_norm=5.0, axes=("stage",),
+        base=ClipByGlobalNorm(max_norm=1.0, base=Sgd(lr=0.1)),
+    )
+    out = shard_aware_clip(nested, ("stage",), None)
+    assert out.axes == ("stage",)  # outer untouched (already axed)
+    assert out.base.axes == ("stage",)  # inner rewrapped by recursion
+    # Idempotent, and pass-through on plain optimizers.
+    again = shard_aware_clip(out, ("data",), None)
+    assert again.base.axes == ("stage",)
+    assert shard_aware_clip(Sgd(lr=0.1), ("data",), None) == Sgd(lr=0.1)
+    # Clip under Scheduled is rejected by Scheduled itself (lr contract).
+    with pytest.raises(ValueError, match="lr"):
+        Scheduled(base=ClipByGlobalNorm(max_norm=1.0, base=Sgd(lr=0.1)),
+                  schedule=constant(0.1))

@@ -77,6 +77,7 @@ def test_gqa_ring_cp_matches_full(x):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=1e-5)
 
 
+@pytest.mark.slow  # ~16s compile; GQA math + TP rules are each fast-covered
 def test_gqa_composes_with_tensor_parallelism(x):
     """GQA under TP stays correct even when the shrunken K/V kernels can't
     shard head-aligned (apply_rules demotes them to replicated; GSPMD

@@ -100,6 +100,7 @@ def test_mp_composes_with_dp(batch):
     np.testing.assert_allclose(losses, losses_1, rtol=1e-4)
 
 
+@pytest.mark.slow  # ~20s; engine parity is pinned by the fast tests above
 def test_task4_end_to_end(tmp_path):
     import tasks.task4 as task4
 

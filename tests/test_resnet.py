@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from tpudml.core.config import MeshConfig
 from tpudml.core.dist import make_mesh
@@ -103,6 +104,7 @@ def test_bottleneck_forward_and_projection():
     assert "proj" in params["block0"]
 
 
+@pytest.mark.slow  # ~9s CPU compile; resnet18/34 structure is fast-covered
 def test_resnet50_structure():
     from tpudml.models import ResNet50
 

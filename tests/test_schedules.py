@@ -137,6 +137,7 @@ def test_remat_reachable_from_model():
     )
 
 
+@pytest.mark.slow  # ~22s compile; ring-backward parity also pinned in test_cp
 def test_ring_attention_remat_flag_compat():
     """``remat=`` is accepted for API compatibility only: the ring
     custom-VJP backward always recomputes per block (flash-style), so the

@@ -36,8 +36,11 @@ COMMON = [
         ["--parallel", "tp", "--n_devices", "4"],
         # pp is one block PER STAGE (4 layers here vs 1 above) — the deeper
         # model needs a few more steps to pass the same loss bar.
-        ["--parallel", "pp", "--n_devices", "4", "--microbatches", "4",
-         "--steps", "80"],
+        pytest.param(
+            ["--parallel", "pp", "--n_devices", "4", "--microbatches", "4",
+             "--steps", "80"],
+            marks=pytest.mark.slow,  # ~14s; pipeline parity lives in test_pp*
+        ),
         ["--parallel", "ep", "--n_devices", "4", "--moe_experts", "8"],
         ["--parallel", "single", "--rope", "--num_kv_heads", "2"],
     ],

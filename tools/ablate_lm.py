@@ -8,13 +8,14 @@ the round-3 MFU tuning recorded in BASELINE.md.
 
 from __future__ import annotations
 
+import os
 import sys
 import time
 
 import jax
 import jax.numpy as jnp
 
-sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from bench import _make_step_body, _time_fori, _compiled_flops, _peak_flops  # noqa: E402
 
 from tpudml.core.prng import seed_key

@@ -14,13 +14,14 @@ per-ring-position time profiles.
 
 from __future__ import annotations
 
+import os
 import sys
 from functools import partial
 
 import jax
 import jax.numpy as jnp
 
-sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from tools.micro_lm import time_fn  # fori-protocol timer with LICM guard
 from tpudml.ops import flash_forward_lse
 

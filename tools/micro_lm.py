@@ -7,6 +7,7 @@ flash-attention kernel at several block sizes, and the head matmul+loss.
 
 from __future__ import annotations
 
+import os
 import sys
 import time
 from functools import partial
@@ -14,7 +15,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from bench import _fetch  # noqa: E402
 
 

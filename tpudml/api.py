@@ -98,7 +98,12 @@ class Model:
             from tpudml.parallel.dp import DataParallel
 
             self._engine = DataParallel(
-                network, optimizer, mesh, rng_root=self._rng_root, loss=loss_fn
+                network, optimizer, mesh, rng_root=self._rng_root, loss=loss_fn,
+                # The facade always feeds plain global [B, ...] batches —
+                # never the ShardedDataLoader's stacked [world, B, ...]
+                # form — so bypass shape inference entirely (ADVICE r2:
+                # the inference misreads stacked flat-feature batches).
+                stacked_batches=False,
             )
             self.state = self._engine.create_state(key)
         else:
@@ -134,11 +139,19 @@ class Model:
         dataset_sink_mode: bool = True,
     ) -> "Model":
         """Train in place for ``epochs`` passes over ``dataset`` (any
-        iterable of (images, labels); DataLoader supported incl.
-        set_epoch). Returns self for chaining."""
+        iterable of (images, labels); DataLoader and ShardedDataLoader
+        supported incl. set_epoch). Returns self for chaining."""
         callbacks = list(callbacks or [])
         if not dataset_sink_mode and self._engine is not None:
             raise ValueError("eager mode is single-device; drop mesh= to use it")
+        if self._engine is not None:
+            # Structural batch-form tagging (ADVICE r2): the loader TYPE
+            # decides stacked [world, B, ...] vs plain global [B, ...]
+            # batches — never shape inference, which misreads stacked
+            # flat-feature batches.
+            from tpudml.data import ShardedDataLoader
+
+            self._engine.stacked_batches = isinstance(dataset, ShardedDataLoader)
         if dataset_sink_mode and self._sink_step is None:
             if self._engine is not None:
                 self._sink_step = self._engine.make_train_step()

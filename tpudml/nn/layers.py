@@ -284,3 +284,24 @@ class Sequential(Module):
             if s2:
                 new_state[f"layer{i}"] = s2
         return x, new_state
+
+
+def iter_module_tree(obj):
+    """Yield ``obj`` and every nested candidate module: dataclass fields,
+    tuple/list items, and dict values. The ONE walker behind structural
+    model inspection (dropout detection in the pipeline engines, MoE
+    detection in the training engine) — containers added here propagate
+    to every detector at once instead of drifting per copy (ADVICE r2 +
+    review r3)."""
+    import dataclasses
+
+    yield obj
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        for f in dataclasses.fields(obj):
+            yield from iter_module_tree(getattr(obj, f.name))
+    elif isinstance(obj, (tuple, list)):
+        for o in obj:
+            yield from iter_module_tree(o)
+    elif isinstance(obj, dict):
+        for o in obj.values():
+            yield from iter_module_tree(o)

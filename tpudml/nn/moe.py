@@ -113,11 +113,10 @@ class MoELayer(Module):
         counts = jnp.zeros((e,), jnp.float32)  # slots used per expert
         disp = jnp.zeros((g, e, cap), jnp.float32)
         combine = jnp.zeros((g, e, cap), jnp.float32)
-        onehot0 = None
+        choice_sum = jnp.zeros((g, e), jnp.float32)  # Σ_j onehot_j per token
         for j in range(self.top_k):
             onehot = jax.nn.one_hot(topi[:, j], e, dtype=jnp.float32)  # [G, E]
-            if j == 0:
-                onehot0 = onehot
+            choice_sum = choice_sum + onehot
             pos = counts[None, :] + jnp.cumsum(onehot, axis=0) - onehot  # [G, E]
             kept = onehot * (pos < cap)
             slot = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)
@@ -151,9 +150,12 @@ class MoELayer(Module):
             )
         y = jnp.einsum("gec,ecd->gd", combine.astype(expert_out.dtype), expert_out)
         # Switch/GShard aux loss over this shard's tokens: E · Σ_e frac_e ·
-        # p̄_e with frac from each token's FIRST choice (=1 when routing is
-        # uniform); differentiable through probs.
-        frac = jnp.mean(onehot0, axis=0)
+        # p̄_e, with frac_e the dispatch fraction averaged over ALL k
+        # choices (GShard's formulation; =1 when routing is uniform).
+        # First-choice-only frac (ADVICE r2) would leave secondary-choice
+        # expert collapse invisible to the loss; differentiable through
+        # probs.
+        frac = jnp.mean(choice_sum, axis=0) / self.top_k
         aux = self.num_experts * jnp.sum(frac * jnp.mean(probs, axis=0))
         return y.reshape(shape), {"aux_loss": aux}
 

@@ -255,15 +255,25 @@ class ClipByGlobalNorm(Optimizer):
 
 
 def shard_aware_clip(opt: Optimizer, axes: tuple, sharded) -> Optimizer:
-    """Rewrap a top-level :class:`ClipByGlobalNorm` (when the caller didn't
-    already set ``axes``) so its norm reduces across the engine's mesh axes.
-    Engines whose ``optimizer.update`` runs on device-local gradient shards
-    call this on their optimizer at construction; anything else passes
-    through untouched."""
-    if isinstance(opt, ClipByGlobalNorm) and not opt.axes:
-        import dataclasses
+    """Rewrap any :class:`ClipByGlobalNorm` in the optimizer chain (when
+    the caller didn't already set ``axes``) so its norm reduces across the
+    engine's mesh axes. Engines whose ``optimizer.update`` runs on
+    device-local gradient shards call this on their optimizer at
+    construction. Recurses through ``.base`` wrapper chains (clip under
+    clip today — ``Scheduled`` rejects a clip base at construction — and
+    any future wrapper with a ``.base``): a clip nested below the top of
+    the chain would otherwise silently compute per-shard norms inside
+    shard_map and de-synchronize replicated params (ADVICE r2)."""
+    import dataclasses
 
-        return dataclasses.replace(opt, axes=tuple(axes), sharded=sharded)
+    if isinstance(opt, ClipByGlobalNorm) and not opt.axes:
+        opt = dataclasses.replace(opt, axes=tuple(axes), sharded=sharded)
+        # fall through: the clip's own .base may nest another clip
+    base = getattr(opt, "base", None)
+    if isinstance(base, Optimizer):
+        new_base = shard_aware_clip(base, axes, sharded)
+        if new_base is not base:
+            opt = dataclasses.replace(opt, base=new_base)
     return opt
 
 

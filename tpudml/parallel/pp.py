@@ -74,25 +74,18 @@ _grad_scale.defvjp(_grad_scale_fwd, _grad_scale_bwd)
 
 
 def _has_dropout(module) -> bool:
-    """Recursively detect dropout in a Module tree (a ``dropout`` field or
-    a nested ``Dropout`` layer, e.g. inside a Sequential)."""
-    import dataclasses
+    """Detect active dropout anywhere in a Module tree (a ``dropout``
+    field or a nested ``Dropout`` layer — rate-0 Dropout is the identity,
+    not "active"). Traversal delegated to the shared walker."""
+    from tpudml.nn.layers import Dropout, iter_module_tree
 
-    from tpudml.nn.layers import Dropout
-
-    def scan(obj) -> bool:
-        # rate-0 Dropout is the identity — not "active" dropout.
+    for obj in iter_module_tree(module):
         if isinstance(obj, Dropout):
-            return bool(getattr(obj, "rate", 0.0))
-        if getattr(obj, "dropout", 0.0):
+            if getattr(obj, "rate", 0.0):
+                return True
+        elif getattr(obj, "dropout", 0.0):
             return True
-        if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
-            return any(scan(getattr(obj, f.name)) for f in dataclasses.fields(obj))
-        if isinstance(obj, (tuple, list)):
-            return any(scan(o) for o in obj)
-        return False
-
-    return scan(module)
+    return False
 
 
 def _spec_shardings(spec_tree: PyTree, mesh: Mesh) -> PyTree:

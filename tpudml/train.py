@@ -60,24 +60,16 @@ DEFAULT_MOE_AUX_WEIGHT = 1e-2  # the canonical Switch load-balancing α
 
 
 def model_has_moe(model: Any) -> bool:
-    """Recursively detect MoE layers in a Module tree (dataclass fields and
-    tuple/list containers), so engines can default the Switch aux-loss
-    pressure on — a dense-MoE run without it lets the top-1 router collapse
-    onto one expert."""
-    import dataclasses
-
+    """Detect MoE layers anywhere in a Module tree (shared walker), so
+    engines can default the Switch aux-loss pressure on — a dense-MoE run
+    without it lets the top-1 router collapse onto one expert."""
+    from tpudml.nn.layers import iter_module_tree
     from tpudml.nn.moe import MoELayer
 
-    def scan(obj) -> bool:
-        if isinstance(obj, MoELayer) or getattr(obj, "moe_experts", 0):
-            return True
-        if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
-            return any(scan(getattr(obj, f.name)) for f in dataclasses.fields(obj))
-        if isinstance(obj, (tuple, list)):
-            return any(scan(o) for o in obj)
-        return False
-
-    return scan(model)
+    return any(
+        isinstance(obj, MoELayer) or getattr(obj, "moe_experts", 0)
+        for obj in iter_module_tree(model)
+    )
 
 
 def resolve_aux_loss_weight(model: Any, aux_loss_weight: float | None) -> float:
@@ -197,6 +189,54 @@ def make_train_step_body(
             step=ts.step + 1,
         )
         return new_ts, metrics
+
+    return step
+
+
+def make_lm_fused_train_step(
+    model: Module,
+    optimizer: Optimizer,
+    rng_root: jax.Array | None = None,
+) -> Callable:
+    """Jitted LM train step through the fused linear-cross-entropy kernel
+    (``tpudml.ops.xent_kernel``): the [B·T, V] logits are never
+    materialized — residual memory for the head drops from O(B·T·V) to
+    O(B·T), the enabling trade for very long sequences / large vocabs.
+    The model must expose ``apply_features`` (TransformerLM) and a
+    ``head`` Dense param subtree. Metrics carry loss only (no logits ⇒
+    no accuracy; use the standard step when accuracy matters). MoE
+    models get the Switch aux-loss pressure exactly like the standard
+    step (None → α=0.01 when MoE layers are present)."""
+    from tpudml.ops.xent_kernel import linear_cross_entropy
+
+    aux_w = resolve_aux_loss_weight(model, None)
+
+    def loss_fn(params, model_state, tokens, labels, rng):
+        feats, new_state = model.apply_features(
+            params, model_state, tokens, train=True, rng=rng
+        )
+        head = model._cast_params(params)["head"]
+        loss = linear_cross_entropy(
+            feats, head["kernel"], labels, head.get("bias")
+        )
+        if aux_w:
+            loss = loss + aux_w * collect_aux_losses(new_state)
+        return loss, new_state
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def step(ts: TrainState, tokens, labels):
+        rng = None if rng_root is None else jax.random.fold_in(rng_root, ts.step)
+        (loss, model_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            ts.params, ts.model_state, tokens, labels, rng
+        )
+        new_params, new_opt = optimizer.update(grads, ts.opt_state, ts.params)
+        new_ts = TrainState(
+            params=new_params,
+            model_state=model_state,
+            opt_state=new_opt,
+            step=ts.step + 1,
+        )
+        return new_ts, {"loss": loss}
 
     return step
 
