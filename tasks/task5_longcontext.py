@@ -67,9 +67,17 @@ def parse_args(argv=None) -> argparse.Namespace:
     )
     p.add_argument("--microbatches", type=int, default=4, help="pp micro-batches")
     p.add_argument(
+        "--fused_xent", action="store_true",
+        help="single-device only: fused linear-cross-entropy head "
+        "(Pallas) — the [B*T, V] logits are never materialized, trading "
+        "~2 ms/step of score recompute for O(B*T) head residual memory "
+        "(very long T / large vocab regimes); loss-only metrics",
+    )
+    p.add_argument(
         "--target_loss", type=float, default=None,
-        help="stop when train loss reaches this value (checked every 10 "
-        "steps); the run then reports steps/time-to-target",
+        help="stop when train loss reaches this value (checked on "
+        "--log_every steps, where the loss is already fetched; every 10 "
+        "steps when --log_every 0); the run reports steps/time-to-target",
     )
     p.add_argument(
         "--pp_data", type=int, default=1,
@@ -115,6 +123,8 @@ def parse_args(argv=None) -> argparse.Namespace:
 def build_engine(args, devices):
     """(train_state, step_fn) for the selected strategy."""
     n = len(devices)
+    if getattr(args, "fused_xent", False) and args.parallel != "single":
+        raise ValueError("--fused_xent requires --parallel single")
     base = dict(
         vocab_size=args.vocab,
         embed_dim=args.embed_dim,
@@ -169,6 +179,10 @@ def build_engine(args, devices):
     model = TransformerLM(**base, impl=impl)
     if args.parallel == "single":
         ts = TrainState.create(model, opt, seed_key(args.seed))
+        if args.fused_xent:
+            from tpudml.train import make_lm_fused_train_step
+
+            return ts, make_lm_fused_train_step(model, opt, rng_root=rng_root)
         return ts, make_train_step(model, opt, rng_root=rng_root)
     if args.parallel == "dp":
         mesh = make_mesh(MeshConfig({"data": n}), devices)
@@ -259,6 +273,7 @@ def run(args) -> dict:
     t0 = None
     loss = float("nan")
     hit_target = None
+    time_to_target = None
     final_step = args.steps
     steady_from = 1  # may break out before the steady-state marker step
     for i in range(1, args.steps + 1):
@@ -276,7 +291,7 @@ def run(args) -> dict:
             loss = float(metrics["loss"])
             writer.add_scalar("Train Loss", loss, i)
             print(f"step {i}: loss {loss:.4f}")
-        if args.target_loss is not None and (logged or (
+        if args.target_loss is not None and t0 is not None and (logged or (
             not args.log_every and i % 10 == 0
         )):
             # Convergence-target mode (the reference pins quality targets,
@@ -285,10 +300,17 @@ def run(args) -> dict:
             # Checked on log steps (the loss is already fetched there) so
             # target mode adds no extra host syncs to the timed window;
             # with --log_every 0 it falls back to a fetch every 10 steps.
+            # Gated on t0 (the steady-state marker) so an instantly-met
+            # target cannot break out before the throughput clock starts.
             checked = loss if logged else float(metrics["loss"])
             if checked <= args.target_loss:
                 hit_target, final_step = i, i
-                print(f"target loss {args.target_loss} reached at step {i}")
+                time_to_target = time.time() - t0
+                print(
+                    f"target loss {args.target_loss} reached at step {i} "
+                    f"({time_to_target:.1f}s after steady-state step "
+                    f"{steady_from})"
+                )
                 break
     jax.block_until_ready(ts.params)
     loss = float(metrics["loss"])
@@ -315,6 +337,7 @@ def run(args) -> dict:
         "devices": len(devices),
         "steps_run": final_step,
         "target_reached_at": hit_target,
+        "time_to_target_s": time_to_target,
     }
 
 

@@ -316,8 +316,8 @@ class TransformerLM(Module):
 
         return jax.tree_util.tree_map_with_path(cast, params)
 
-    def apply(self, params, state, tokens, *, train=False, rng=None):
-        params = self._cast_params(params)
+    def _trunk(self, params, state, tokens, train, rng):
+        """embed → blocks (params already cast); no final norm/head."""
         embed_keys = ("tok_embed",) + (() if self.rope else ("pos_embed",))
         h = self._embed()({k: params[k] for k in embed_keys}, tokens)
         block = self._block()
@@ -330,9 +330,25 @@ class TransformerLM(Module):
             )
             if s:
                 new_state[f"block{i}"] = s
+        return h, new_state
+
+    def apply(self, params, state, tokens, *, train=False, rng=None):
+        params = self._cast_params(params)
+        h, new_state = self._trunk(params, state, tokens, train, rng)
         logits = self._head()({k: params[k] for k in ("ln_f", "head")}, h)
         # Logits stay in compute dtype: softmax_cross_entropy computes its
         # statistics in f32 from bf16 logits without materializing an f32
         # copy (a [B·T, 32k] cast is ~1 GB of HBM traffic at LM scale),
         # and argmax/accuracy are dtype-insensitive.
         return logits, new_state
+
+    def apply_features(self, params, state, tokens, *, train=False, rng=None):
+        """Pre-head features: embed → blocks → final LayerNorm, WITHOUT
+        the vocab projection — the input contract of the fused
+        linear-cross-entropy kernel (``tpudml.ops.xent_kernel``), which
+        consumes features + head weights and never materializes the
+        [B·T, V] logits."""
+        params = self._cast_params(params)
+        h, new_state = self._trunk(params, state, tokens, train, rng)
+        h = LayerNorm(self.embed_dim, dtype=self.dtype)(params["ln_f"], h)
+        return h, new_state

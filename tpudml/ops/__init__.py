@@ -11,5 +11,11 @@ from tpudml.ops.attention_kernel import (
     flash_block_grads,
     flash_forward_lse,
 )
+from tpudml.ops.xent_kernel import linear_cross_entropy
 
-__all__ = ["flash_attention", "flash_block_grads", "flash_forward_lse"]
+__all__ = [
+    "flash_attention",
+    "flash_block_grads",
+    "flash_forward_lse",
+    "linear_cross_entropy",
+]

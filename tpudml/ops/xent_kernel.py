@@ -1,0 +1,328 @@
+"""Fused linear-cross-entropy (Pallas, TPU): head matmul + softmax loss
+with the [N, V] logits matrix never materialized.
+
+The LM loss path computes ``logits = x @ W`` ([N, V] — 0.5 GB bf16 at
+N=8k tokens, V=32k) and reduces it to one scalar. Even with the
+memory-lean XLA loss (tpudml/nn/losses.py), the logits buffer itself
+must exist between the matmul and the reductions, and the backward keeps
+it (or recomputes it) at full width. This kernel streams W one vocab
+tile at a time through VMEM — flash-attention's trick applied to the
+classifier head:
+
+- forward: grid (N-blocks, V-blocks), V innermost. Per tile:
+  s = x_tile @ W_tile (f32 on the MXU), folded into a running online
+  softmax (m, l) per row plus the label's logit (fused iota-compare
+  pick). Emits lse [N] and picked [N]; loss = mean(lse - picked).
+  Residuals: x, W, labels, lse — O(N + params), NOT O(N·V).
+- backward: recompute s per tile; dlogits = (exp(s - lse) - onehot)·g/N.
+  Two kernels, mirroring the attention backward split:
+  dX (V innermost): dx_tile += dlogits @ W_tileᵀ;
+  dW (N innermost): dW_tile += x_tileᵀ @ dlogits.
+
+Exactness: same math as ``softmax_cross_entropy`` over the materialized
+logits (f32 statistics); pinned by tests against the XLA reference.
+Dispatch: compiled kernel on TPU; reference math elsewhere (tests force
+``interpret=True``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+# ---------------------------------------------------------------- forward
+
+
+def _fwd_kernel(x_ref, w_ref, b_ref, label_ref, lse_ref, picked_ref, m_ref,
+                l_ref, z_ref, *, block_v: int, v_valid: int):
+    vj = pl.program_id(1)
+    nv = pl.num_programs(1)
+
+    @pl.when(vj == 0)
+    def _():
+        m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        z_ref[:] = jnp.zeros_like(z_ref)
+
+    s = jax.lax.dot_general(
+        x_ref[:], w_ref[:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) + b_ref[:].astype(jnp.float32)  # [bn, bv] (+ broadcast [1, bv] bias)
+    col = vj * block_v + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    if v_valid != block_v * nv:
+        # Padded vocab columns must carry no probability mass.
+        s = jnp.where(col < v_valid, s, -jnp.inf)
+    label = label_ref[:]  # [bn, 1] int32
+    # The pick must exclude padded columns even when a (buggy) label
+    # lands in [V, V_pad): such labels see picked = 0 → loss = lse, the
+    # SAME no-pull-up semantics as any other out-of-range label, instead
+    # of picking the -inf a padded column carries (+inf loss).
+    z_ref[:] += jnp.sum(
+        jnp.where((col == label) & (col < v_valid), s, 0.0),
+        axis=-1, keepdims=True,
+    )
+    m_prev = m_ref[:]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    l_ref[:] = l_ref[:] * jnp.exp(m_prev - m_new) + jnp.sum(
+        jnp.exp(s - m_new), axis=-1, keepdims=True
+    )
+    m_ref[:] = m_new
+
+    @pl.when(vj == nv - 1)
+    def _():
+        lse_ref[:] = m_ref[:] + jnp.log(l_ref[:])
+        picked_ref[:] = z_ref[:]
+
+
+def _fused_forward(x, w, b, labels, block_n, block_v, interpret):
+    n, d = x.shape
+    d2, v = w.shape
+    assert d == d2, (x.shape, w.shape)
+    block_n = min(block_n, _round_up(n, 8))
+    block_v = min(block_v, _round_up(v, 128))
+    n_pad, v_pad = _round_up(n, block_n), _round_up(v, block_v)
+    xf = jnp.pad(x, ((0, n_pad - n), (0, 0))) if n_pad != n else x
+    wf = jnp.pad(w, ((0, 0), (0, v_pad - v))) if v_pad != v else w
+    bf = (jnp.pad(b, (0, v_pad - v)) if v_pad != v else b)[None, :]
+    # Padded rows pick label -1 → match no column → picked 0, lse finite.
+    lf = jnp.pad(labels.astype(jnp.int32), (0, n_pad - n),
+                 constant_values=-1)[:, None]
+    lse, picked = pl.pallas_call(
+        partial(_fwd_kernel, block_v=block_v, v_valid=v),
+        out_shape=[
+            jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
+            jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
+        ],
+        grid=(n_pad // block_n, v_pad // block_v),
+        in_specs=[
+            pl.BlockSpec((block_n, d), lambda i, j: (i, 0)),
+            pl.BlockSpec((d, block_v), lambda i, j: (0, j)),
+            pl.BlockSpec((1, block_v), lambda i, j: (0, j)),
+            pl.BlockSpec((block_n, 1), lambda i, j: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_n, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((block_n, 1), lambda i, j: (i, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_n, 1), jnp.float32),  # running max
+            pltpu.VMEM((block_n, 1), jnp.float32),  # running normalizer
+            pltpu.VMEM((block_n, 1), jnp.float32),  # picked accumulator
+        ],
+        interpret=interpret,
+    )(xf, wf, bf, lf)
+    return lse[:n, 0], picked[:n, 0]
+
+
+# --------------------------------------------------------------- backward
+
+
+def _dx_kernel(x_ref, w_ref, b_ref, label_ref, lse_ref, dx_ref, acc_ref, *,
+               block_v: int, v_valid: int, inv_n: float):
+    vj = pl.program_id(1)
+    nv = pl.num_programs(1)
+
+    @pl.when(vj == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    s = jax.lax.dot_general(
+        x_ref[:], w_ref[:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) + b_ref[:].astype(jnp.float32)
+    col = vj * block_v + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    p = jnp.exp(s - lse_ref[:])
+    if v_valid != block_v * nv:
+        p = jnp.where(col < v_valid, p, 0.0)
+    onehot = (col == label_ref[:]) & (col < v_valid)
+    dlog = (p - onehot.astype(jnp.float32)) * inv_n
+    acc_ref[:] += jax.lax.dot_general(
+        dlog.astype(w_ref.dtype), w_ref[:], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [bn, d]
+
+    @pl.when(vj == nv - 1)
+    def _():
+        dx_ref[:] = acc_ref[:].astype(dx_ref.dtype)
+
+
+def _dw_kernel(w_ref, x_ref, b_ref, label_ref, lse_ref, dw_ref, db_ref,
+               acc_ref, db_acc, *, block_v: int, v_valid: int, inv_n: float):
+    vj = pl.program_id(1)
+    ni = pl.program_id(2)
+    nn = pl.num_programs(2)
+
+    @pl.when(ni == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        db_acc[:] = jnp.zeros_like(db_acc)
+
+    s = jax.lax.dot_general(
+        x_ref[:], w_ref[:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) + b_ref[:].astype(jnp.float32)  # [bn, bv]
+    col = vj * block_v + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    p = jnp.exp(s - lse_ref[:])
+    if v_valid != block_v * pl.num_programs(1):
+        p = jnp.where(col < v_valid, p, 0.0)
+    onehot = (col == label_ref[:]) & (col < v_valid)
+    dlog = (p - onehot.astype(jnp.float32)) * inv_n
+    acc_ref[:] += jax.lax.dot_general(
+        x_ref[:], dlog.astype(x_ref.dtype), (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [d, bv]
+    db_acc[:] += jnp.sum(dlog, axis=0, keepdims=True)  # [1, bv]
+
+    @pl.when(ni == nn - 1)
+    def _():
+        dw_ref[:] = acc_ref[:].astype(dw_ref.dtype)
+        db_ref[:] = db_acc[:].astype(db_ref.dtype)
+
+
+def _fused_backward(x, w, b, labels, lse, g, block_n, block_v, interpret):
+    n, d = x.shape
+    _, v = w.shape
+    block_n = min(block_n, _round_up(n, 8))
+    block_v = min(block_v, _round_up(v, 128))
+    # The dW kernel holds a [d, block_v] f32 scratch PLUS double-buffered
+    # [d, block_v] in/out W tiles; cap its vocab tile so the working set
+    # stays under the ~16 MB scoped-VMEM limit (5 live [d, bv] f32 tiles
+    # + x/dlog  ->  bv <= 12 MB / (5 * 4 * d)).
+    bv_budget = max(128, (12 * 1024 * 1024) // (5 * 4 * d) // 128 * 128)
+    block_v_dw = min(block_v, bv_budget)
+    n_pad, v_pad = _round_up(n, block_n), _round_up(v, block_v)
+    xf = jnp.pad(x, ((0, n_pad - n), (0, 0))) if n_pad != n else x
+    wf = jnp.pad(w, ((0, 0), (0, v_pad - v))) if v_pad != v else w
+    bf = (jnp.pad(b, (0, v_pad - v)) if v_pad != v else b)[None, :]
+    lf = jnp.pad(labels.astype(jnp.int32), (0, n_pad - n),
+                 constant_values=-1)[:, None]
+    # Padded rows: lse=+inf → p = exp(s - inf) = 0 and no onehot match →
+    # dlogits exactly 0, so they contribute nothing to dx or dW.
+    lsef = jnp.pad(lse.astype(jnp.float32), (0, n_pad - n),
+                   constant_values=jnp.inf)[:, None]
+    # The scalar cotangent g is a traced value, so it cannot fold into
+    # the kernels' static inv_n; 1/n scales inside, g multiplies outside
+    # (one fused elementwise pass over dx/dW/db).
+    dx = pl.pallas_call(
+        partial(_dx_kernel, block_v=block_v, v_valid=v, inv_n=1.0 / n),
+        out_shape=jax.ShapeDtypeStruct(xf.shape, x.dtype),
+        grid=(n_pad // block_n, v_pad // block_v),
+        in_specs=[
+            pl.BlockSpec((block_n, d), lambda i, j: (i, 0)),
+            pl.BlockSpec((d, block_v), lambda i, j: (0, j)),
+            pl.BlockSpec((1, block_v), lambda i, j: (0, j)),
+            pl.BlockSpec((block_n, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((block_n, 1), lambda i, j: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_n, d), lambda i, j: (i, 0)),
+        scratch_shapes=[pltpu.VMEM((block_n, d), jnp.float32)],
+        interpret=interpret,
+    )(xf, wf, bf, lf, lsef)[:n]
+    v_pad_dw = _round_up(v, block_v_dw)
+    wfd = jnp.pad(w, ((0, 0), (0, v_pad_dw - v))) if v_pad_dw != v else w
+    bfd = (jnp.pad(b, (0, v_pad_dw - v)) if v_pad_dw != v else b)[None, :]
+    dw, db = pl.pallas_call(
+        partial(_dw_kernel, block_v=block_v_dw, v_valid=v, inv_n=1.0 / n),
+        out_shape=[
+            jax.ShapeDtypeStruct(wfd.shape, w.dtype),
+            jax.ShapeDtypeStruct((1, v_pad_dw), jnp.float32),
+        ],
+        grid=(1, v_pad_dw // block_v_dw, n_pad // block_n),
+        in_specs=[
+            pl.BlockSpec((d, block_v_dw), lambda _, j, i: (0, j)),
+            pl.BlockSpec((block_n, d), lambda _, j, i: (i, 0)),
+            pl.BlockSpec((1, block_v_dw), lambda _, j, i: (0, j)),
+            pl.BlockSpec((block_n, 1), lambda _, j, i: (i, 0)),
+            pl.BlockSpec((block_n, 1), lambda _, j, i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((d, block_v_dw), lambda _, j, i: (0, j)),
+            pl.BlockSpec((1, block_v_dw), lambda _, j, i: (0, j)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((d, block_v_dw), jnp.float32),
+            pltpu.VMEM((1, block_v_dw), jnp.float32),
+        ],
+        interpret=interpret,
+    )(wfd, xf, bfd, lf, lsef)
+    dw = dw[:, :v]
+    db = db[0, :v]
+    gf = g.astype(jnp.float32)
+    return (
+        (dx.astype(jnp.float32) * gf).astype(x.dtype),
+        (dw.astype(jnp.float32) * gf).astype(w.dtype),
+        (db * gf).astype(b.dtype),
+    )
+
+
+# --------------------------------------------------------------- dispatch
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _fused(x, w, b, labels, block_n, block_v, interpret):
+    lse, picked = _fused_forward(x, w, b, labels, block_n, block_v, interpret)
+    return jnp.mean(lse - picked)
+
+
+def _fused_fwd(x, w, b, labels, block_n, block_v, interpret):
+    lse, picked = _fused_forward(x, w, b, labels, block_n, block_v, interpret)
+    return jnp.mean(lse - picked), (x, w, b, labels, lse)
+
+
+def _fused_bwd(block_n, block_v, interpret, res, g):
+    import numpy as np
+
+    x, w, b, labels, lse = res
+    dx, dw, db = _fused_backward(
+        x, w, b, labels, lse, g, block_n, block_v, interpret
+    )
+    return dx, dw, db, np.zeros(labels.shape, dtype=jax.dtypes.float0)
+
+
+_fused.defvjp(_fused_fwd, _fused_bwd)
+
+
+def linear_cross_entropy(
+    x: jax.Array,
+    w: jax.Array,
+    labels: jax.Array,
+    bias: jax.Array | None = None,
+    *,
+    block_n: int = 256,
+    block_v: int = 2048,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Mean softmax cross-entropy of ``x @ w [+ bias]`` against integer
+    ``labels`` without materializing the [N, V] logits (see module
+    docstring).
+
+    ``x`` [..., d] flattens to [N, d]; ``labels`` [...] to [N]. Labels
+    outside [0, V) contribute loss = lse (no pull-up) — mask such rows
+    out beforehand. On non-TPU backends dispatches to the XLA reference
+    math unless ``interpret=True`` forces the Pallas interpreter."""
+    d = x.shape[-1]
+    v = w.shape[-1]
+    xn = x.reshape(-1, d)
+    ln = labels.reshape(-1)
+    if xn.shape[0] != ln.shape[0]:
+        raise ValueError(f"{x.shape} rows != {labels.shape} labels")
+    if interpret is None:
+        if jax.default_backend() != "tpu":
+            from tpudml.nn.losses import softmax_cross_entropy
+
+            logits = xn @ w
+            if bias is not None:
+                logits = logits + bias
+            return softmax_cross_entropy(logits.astype(jnp.float32), ln)
+        interpret = False
+    b = jnp.zeros((v,), w.dtype) if bias is None else bias
+    return _fused(xn, w, b, ln, block_n, block_v, interpret)
