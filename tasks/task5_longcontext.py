@@ -19,9 +19,10 @@ next-token data with a selectable parallelism/attention strategy:
 - ``--parallel pp``      micro-batched pipeline — one decoder block per
                          stage over a {"stage": N} mesh (depth = N;
                          ``--num_layers`` is ignored in this mode);
-                         ``--schedule gpipe`` (scan+AD) or ``1f1b``
-                         (interleaved backwards: S-bounded activation
-                         memory, dropout-capable);
+                         ``--schedule gpipe`` (scan+AD), ``1f1b``
+                         (S-bounded activation memory, dropout-capable),
+                         or ``interleaved`` (virtual stages — v_chunks
+                         blocks per device, ~v_chunks× smaller bubble);
 - ``--parallel ep``      expert parallelism — requires ``--moe_experts N``;
                          the Switch-MoE FFN's experts shard over an
                          {"expert": N} mesh with all_to_all dispatch.
@@ -80,14 +81,21 @@ def parse_args(argv=None) -> argparse.Namespace:
         "steps when --log_every 0); the run reports steps/time-to-target",
     )
     p.add_argument(
+        "--v_chunks", type=int, default=2,
+        help="--schedule interleaved: model chunks per device (virtual "
+        "stages; pipeline depth becomes v_chunks * n_stages — like the "
+        "other pp schedules, --num_layers is ignored)",
+    )
+    p.add_argument(
         "--pp_data", type=int, default=1,
         help="pp only: data-parallel replicas composed with the pipeline "
         "(2-D {data, stage} mesh; n_devices/pp_data stages per replica)",
     )
     p.add_argument(
-        "--schedule", choices=["gpipe", "1f1b"], default="gpipe",
-        help="pp schedule: gpipe (scan+AD) or 1f1b (interleaved, S-bounded "
-        "activation memory, dropout-capable)",
+        "--schedule", choices=["gpipe", "1f1b", "interleaved"], default="gpipe",
+        help="pp schedule: gpipe (scan+AD), 1f1b (S-bounded activation "
+        "memory, dropout-capable), interleaved (virtual stages: v_chunks "
+        "blocks per device -> depth v_chunks*N, ~v_chunks x smaller bubble)",
     )
     p.add_argument("--attn", choices=["full", "flash", "ring", "ulysses"], default=None,
                    help="attention impl; defaults: single/dp/tp=full, cp=ring")
@@ -207,8 +215,10 @@ def build_engine(args, devices):
         # and supports --dropout via per-(stage, micro) rng keys.
         if args.moe_experts:
             raise ValueError("--parallel pp does not support --moe_experts")
-        if args.dropout and args.schedule != "1f1b":
-            raise ValueError("--dropout pipelines need --schedule 1f1b")
+        if args.dropout and args.schedule not in ("1f1b", "interleaved"):
+            raise ValueError(
+                "--dropout pipelines need --schedule 1f1b or interleaved"
+            )
         from tpudml.models import TransformerBlock, TransformerEmbed, TransformerHead
         from tpudml.parallel.pp import GPipe, OneFOneB
 
@@ -237,7 +247,13 @@ def build_engine(args, devices):
             num_kv_heads=args.num_kv_heads, rope=args.rope,
             dropout=args.dropout,
         )
-        if args.schedule == "1f1b":
+        if args.schedule == "interleaved":
+            from tpudml.parallel.pp import Interleaved1F1B
+
+            pipe = Interleaved1F1B(
+                block, rng_root=rng_root, v_chunks=args.v_chunks, **common
+            )
+        elif args.schedule == "1f1b":
             pipe = OneFOneB(block, rng_root=rng_root, **common)
         else:
             pipe = GPipe(block, **common)

@@ -66,6 +66,7 @@ def test_entry_and_dryrun_from_clean_environment():
         "1f1b pipeline ok",
         "pp x dp ok",
         "hetero conv->fc pipeline ok",
+        "interleaved 1f1b ok",
     ):
         assert regime in proc.stdout, f"missing regime '{regime}':\n{proc.stdout}"
 

@@ -1,0 +1,115 @@
+"""Interleaved (virtual-stage) 1F1B: V model chunks per device.
+
+Parity oracle: sequential application of the V*S blocks in virtual-stage
+order (sigma = v*S + s -> device s chunk v) on one device. The verdict-r2
+stretch item: bubble below plain 1F1B's (S-1)/(M+S-1) by making each
+ramp tick one block instead of V blocks.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpudml.core.config import MeshConfig
+from tpudml.core.dist import make_mesh
+from tpudml.core.prng import seed_key
+from tpudml.nn import Activation, Dense, Dropout, Sequential
+from tpudml.nn.losses import softmax_cross_entropy
+from tpudml.optim import make_optimizer
+from tpudml.parallel.pp import Interleaved1F1B
+
+STAGES = 4
+WIDTH = 24
+BATCH = 16
+
+
+def make_pipe(n_mb=4, v_chunks=2, opt=None, dropout=0.0, rng_root=None,
+              n_data=1):
+    layers = [Dense(WIDTH, WIDTH), Activation(jax.nn.relu)]
+    if dropout:
+        layers.append(Dropout(dropout))
+    if n_data > 1:
+        mesh = make_mesh(
+            MeshConfig({"data": n_data, "stage": STAGES}),
+            jax.devices()[: n_data * STAGES],
+        )
+    else:
+        mesh = make_mesh(MeshConfig({"stage": STAGES}), jax.devices()[:STAGES])
+    return Interleaved1F1B(
+        Sequential(tuple(layers)),
+        n_microbatches=n_mb,
+        mesh=mesh,
+        optimizer=opt or make_optimizer("sgd", 0.05, momentum=0.9),
+        prologue=Dense(12, WIDTH),
+        epilogue=Dense(WIDTH, 10),
+        v_chunks=v_chunks,
+        rng_root=rng_root,
+        batch_axis="data" if n_data > 1 else None,
+    )
+
+
+@pytest.fixture(scope="module")
+def batch():
+    rng = np.random.default_rng(11)
+    x = rng.normal(size=(BATCH, 12)).astype(np.float32)
+    y = rng.integers(0, 10, size=(BATCH,)).astype(np.int32)
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+@pytest.mark.parametrize("n_mb,v", [(4, 2), (8, 2), (4, 3), (4, 1)])
+def test_update_matches_single_device(batch, n_mb, v):
+    """V*S-block model: first interleaved update == single-device update.
+    v=1 degenerates to the plain 1F1B schedule."""
+    x, y = batch
+    opt = make_optimizer("sgd", 0.05, momentum=0.9)
+    pipe = make_pipe(n_mb, v, opt=opt)
+    ts = pipe.create_state(seed_key(1))
+    params0 = jax.device_get(ts.params)
+
+    new_ts, metrics = pipe.make_train_step()(ts, x, y)
+
+    ref_loss = lambda p: softmax_cross_entropy(pipe.sequential_forward(p, x), y)
+    loss0, ref_grads = jax.value_and_grad(ref_loss)(params0)
+    ref_params, _ = opt.update(ref_grads, opt.init(params0), params0)
+
+    np.testing.assert_allclose(float(metrics["loss"]), float(loss0), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(new_ts.params), jax.tree.leaves(ref_params)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6
+        )
+
+
+def test_training_descends_with_dropout(batch):
+    x, y = batch
+    pipe = make_pipe(4, 2, dropout=0.2, rng_root=seed_key(7))
+    ts = pipe.create_state(seed_key(2))
+    step = pipe.make_train_step()
+    losses = []
+    for _ in range(8):
+        ts, m = step(ts, x, y)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0]
+
+
+def test_dropout_without_rng_rejected():
+    with pytest.raises(ValueError, match="rng_root"):
+        make_pipe(4, 2, dropout=0.5).init_params(seed_key(0))
+
+
+def test_interleaved_composes_with_dp(batch):
+    x, y = batch
+    opt = make_optimizer("sgd", 0.05, momentum=0.9)
+    pipe = make_pipe(2, 2, opt=opt, n_data=2)
+    ts = pipe.create_state(seed_key(1))
+    params0 = jax.device_get(ts.params)
+    new_ts, metrics = pipe.make_train_step()(ts, x, y)
+
+    ref_loss = lambda p: softmax_cross_entropy(pipe.sequential_forward(p, x), y)
+    loss0, ref_grads = jax.value_and_grad(ref_loss)(params0)
+    ref_params, _ = opt.update(ref_grads, opt.init(params0), params0)
+    np.testing.assert_allclose(float(metrics["loss"]), float(loss0), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(new_ts.params), jax.tree.leaves(ref_params)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6
+        )

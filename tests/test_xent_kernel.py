@@ -106,3 +106,7 @@ def test_out_of_range_labels_give_lse_loss_not_inf():
                                        interpret=True)
     )(x)
     assert np.all(np.isfinite(np.asarray(g)))
+    # The non-TPU fallback dispatch must implement the SAME semantics
+    # (loss = lse, no pull-up for invalid ids — NOT edge-class clamping).
+    fallback = linear_cross_entropy(x, w, y)  # CPU default dispatch
+    np.testing.assert_allclose(float(fallback), float(loss), rtol=1e-6)

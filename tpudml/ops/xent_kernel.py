@@ -317,12 +317,25 @@ def linear_cross_entropy(
         raise ValueError(f"{x.shape} rows != {labels.shape} labels")
     if interpret is None:
         if jax.default_backend() != "tpu":
-            from tpudml.nn.losses import softmax_cross_entropy
-
+            # XLA fallback with the SAME out-of-range-label semantics as
+            # the kernel (loss = lse, no pull-up) — softmax_cross_entropy
+            # would CLAMP invalid ids to an edge class, silently training
+            # differently per backend.
             logits = xn @ w
             if bias is not None:
                 logits = logits + bias
-            return softmax_cross_entropy(logits.astype(jnp.float32), ln)
+            logits = logits.astype(jnp.float32)
+            m = jnp.max(logits, axis=-1)
+            lse = m + jnp.log(
+                jnp.sum(jnp.exp(logits - m[:, None]), axis=-1)
+            )
+            ids = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+            picked = jnp.sum(
+                jnp.where(ids == ln[:, None].astype(jnp.int32), logits, 0.0),
+                axis=-1,
+            )
+            valid = (ln >= 0) & (ln < v)
+            return jnp.mean(lse - jnp.where(valid, picked, 0.0))
         interpret = False
     b = jnp.zeros((v,), w.dtype) if bias is None else bias
     return _fused(xn, w, b, ln, block_n, block_v, interpret)

@@ -25,7 +25,7 @@ from tpudml.parallel.mp import (
     stage_sharding_rules,
     tensor_parallel_rules,
 )
-from tpudml.parallel.pp import GPipe, HeteroPipeline, OneFOneB
+from tpudml.parallel.pp import GPipe, HeteroPipeline, Interleaved1F1B, OneFOneB
 
 __all__ = [
     "ContextParallel",
@@ -36,6 +36,7 @@ __all__ = [
     "fsdp_sharding_rules",
     "GPipe",
     "HeteroPipeline",
+    "Interleaved1F1B",
     "OneFOneB",
     "GSPMDParallel",
     "ring_attention",

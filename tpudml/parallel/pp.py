@@ -793,3 +793,325 @@ class HeteroPipeline(GPipe):
         for s, st in enumerate(self.stages):
             h = st(self._unravel(s, params["stages"][s]), h)
         return h
+
+
+class Interleaved1F1B(GPipe):
+    """Interleaved (virtual-stage) 1F1B: each device hosts ``v_chunks``
+    NON-adjacent model chunks (Megatron's interleaved schedule lineage) —
+    virtual stage σ = v·S + s runs chunk v on device s, so the model is
+    L = V·S blocks deep while the per-tick unit shrinks to ONE block.
+
+    Why: the plain 1F1B/GPipe bubble is (S-1) *stage* units of ramp-up
+    and ramp-down, where a stage unit is all V blocks a device holds.
+    Interleaving keeps the ramp at the same number of ticks but makes
+    each tick 1/V of the work: total ticks 2(M + V·S - 1) of one-block
+    units vs 2(M + S - 1) of V-block units — faster whenever V > 1 and
+    M > 1, approaching a V× smaller bubble for M >> S.
+
+    Schedule (lockstep SPMD scan, one program):
+    - fwd(σ, m) at tick t = σ + 2m; bwd(σ, m) at t = 2·V·S - σ - 1 + 2m
+      (the OneFOneB timing over VIRTUAL stages). On even S two chunks of
+      one device can land on the same tick; the per-tick chunk loop
+      simply runs both (the tick costs two units then — the schedule
+      stays correct, just locally denser).
+    - activations ppermute device s → s+1 every tick in a [V, ...]
+      buffer slotted by the SENDER's chunk; the ring wrap S-1 → 0 is the
+      chunk boundary, so device 0 reads slot v-1 for its chunk-v input
+      while everyone else reads slot v. Cotangents mirror this on the
+      reverse ring (device S-1 reads slot v+1).
+    - backwards are hand-rolled per-(chunk, micro) ``jax.vjp`` calls that
+      recompute the chunk forward from a saved input (OneFOneB's
+      flash-style remat); the input buffer holds V·S slots per chunk
+      (slot m mod V·S — fwd(σ, m') reuses bwd(σ, m)'s slot only after
+      m' ≥ m + V·S - σ, so V·S slots are always safe). The memory trade
+      vs OneFOneB: V·S·V in-flight micro-activations instead of S, and
+      the per-tick ppermute carries the full [V, ...] buffer though at
+      most one (two on even-S collision ticks) slot is live — V× the
+      minimal transfer volume, accepted because V is small (2-3) and a
+      single-slot buffer cannot represent the even-S double-unit ticks.
+    - dropout: per-(virtual stage, micro) keys, refolded identically in
+      the backward recompute — grads stay exact for the dropout-applied
+      function (the OneFOneB contract).
+
+    Parity oracle: ``sequential_forward`` applies the V·S blocks in σ
+    order on one device; the schedule must match its loss and update
+    exactly. ``v_chunks=1`` degenerates to OneFOneB's schedule.
+    Stateless shape-preserving blocks; composes with DP via
+    ``batch_axis`` like the other pipeline engines.
+    """
+
+    def __init__(self, *args, v_chunks: int = 2,
+                 rng_root: jax.Array | None = None, **kwargs):
+        self.v_chunks = v_chunks
+        self.rng_root = rng_root
+        super().__init__(*args, **kwargs)
+        if v_chunks < 1:
+            raise ValueError(f"v_chunks {v_chunks} must be >= 1")
+
+    def _validate_block(self, states) -> None:
+        if jax.tree.leaves(states):
+            raise ValueError("pipeline blocks must be stateless (no BatchNorm)")
+        if _has_dropout(self.block) and self.rng_root is None:
+            raise ValueError("dropout pipeline stages need rng_root")
+
+    # ---------------------------------------------------------------- params
+
+    def init_params(self, key: jax.Array) -> PyTree:
+        """Stacked [S, V, ...] per-block params, sharded over ``stage`` on
+        the leading axis; block σ = v·S + s lives at [s, v]."""
+        kp, kb, ke = jax.random.split(key, 3)
+        S, V = self.n_stages, self.v_chunks
+        keys = jax.random.split(kb, S * V).reshape(S, V)
+        # vmap over devices and chunks: [S, V] leading axes.
+        stacked, states = jax.vmap(jax.vmap(lambda k: self.block.init(k)))(
+            keys
+        )
+        self._validate_block(states)
+        pro = self.prologue.init(kp)[0] if self.prologue is not None else {}
+        epi = self.epilogue.init(ke)[0] if self.epilogue is not None else {}
+        return {"prologue": pro, "stages": stacked, "epilogue": epi}
+
+    def sequential_forward(self, params: PyTree, x: jax.Array) -> jax.Array:
+        S, V = self.n_stages, self.v_chunks
+        h = x
+        if self.prologue is not None:
+            h = self.prologue(params["prologue"], h)
+        for sigma in range(V * S):
+            s, v = sigma % S, sigma // S
+            h = self.block(
+                jax.tree.map(lambda p, s=s, v=v: p[s, v], params["stages"]), h
+            )
+        if self.epilogue is not None:
+            h = self.epilogue(params["epilogue"], h)
+        return h
+
+    # -------------------------------------------------------------- schedule
+
+    def _spmd_step(self, ts: TrainState, x, labels):
+        axis, S, M, V = self.axis_name, self.n_stages, self.n_microbatches, \
+            self.v_chunks
+        VS = V * S
+        stage = lax.axis_index(axis)
+        train = self.rng_root is not None
+        step_key = (
+            jax.random.fold_in(self.rng_root, ts.step) if train else None
+        )
+
+        # Local chunk params: [1, V, ...] slice -> [V, ...].
+        local = jax.tree.map(lambda p: p[0], ts.params["stages"])
+        p_pro, p_epi = ts.params["prologue"], ts.params["epilogue"]
+
+        batch = x.shape[0]
+        if batch % M:
+            raise ValueError(f"batch {batch} not divisible by {M} microbatches")
+        mb = x.reshape(M, batch // M, *x.shape[1:])
+        mb_labels = labels.reshape(M, batch // M, *labels.shape[1:])
+
+        def run_pro(xm):
+            return self.prologue(p_pro, xm) if self.prologue is not None else xm
+
+        def key_for(v, m):
+            if step_key is None:
+                return None
+            sigma = v * S + stage
+            key = jax.random.fold_in(jax.random.fold_in(step_key, sigma), m)
+            if self.batch_axis:
+                key = jax.random.fold_in(key, lax.axis_index(self.batch_axis))
+            return key
+
+        def chunk_params(v):
+            return jax.tree.map(
+                lambda p: lax.dynamic_index_in_dim(p, v, keepdims=False), local
+            )
+
+        def run_block(p, xin, key):
+            return self.block.apply(p, {}, xin, train=train, rng=key)[0]
+
+        act_template = jax.eval_shape(run_pro, jax.ShapeDtypeStruct(
+            mb.shape[1:], mb.dtype
+        ))
+        zeros_act = jnp.zeros(act_template.shape, act_template.dtype)
+        zeros_chunks = jax.tree.map(jnp.zeros_like, local)  # [V, ...]
+        zeros_pro = jax.tree.map(jnp.zeros_like, p_pro)
+        zeros_epi = jax.tree.map(jnp.zeros_like, p_epi)
+
+        def tick(carry, t):
+            (act_buf, fwd_recv, bwd_recv, g_ch, g_pro, g_epi,
+             loss_sum, acc_sum) = carry
+            # act_buf: [V, VS, ...] saved chunk inputs.
+            # fwd_recv/bwd_recv: [V, ...] slotted by SENDER chunk.
+            fwd_send = jnp.zeros((V,) + zeros_act.shape, zeros_act.dtype)
+            bwd_send = jnp.zeros((V,) + zeros_act.shape, zeros_act.dtype)
+
+            for v in range(V):  # static unroll: per-chunk units this tick
+                sigma = v * S + stage
+
+                # ------------------------------------------ forward unit
+                tf = t - sigma
+                valid_f = (tf >= 0) & (tf % 2 == 0) & (tf < 2 * M)
+                m_f = jnp.clip(tf // 2, 0, M - 1)
+                xm_f = lax.dynamic_index_in_dim(mb, m_f, keepdims=False)
+                # Chunk-v input: stage 0 feeds micro m (v=0) or reads the
+                # wrap slot v-1; other stages read slot v.
+                recv_slot = jnp.where(stage == 0, max(v - 1, 0), v)
+                x_in = lax.dynamic_index_in_dim(
+                    fwd_recv, recv_slot, keepdims=False
+                )
+                if v == 0:
+                    x_in = jnp.where(stage == 0, run_pro(xm_f), x_in)
+                act_buf = lax.cond(
+                    valid_f,
+                    lambda b: jax.tree.map(
+                        lambda bb, xx: lax.dynamic_update_index_in_dim(
+                            bb, lax.dynamic_update_index_in_dim(
+                                lax.dynamic_index_in_dim(bb, v, keepdims=False),
+                                xx, m_f % VS, 0,
+                            ), v, 0,
+                        ),
+                        b, x_in,
+                    ),
+                    lambda b: b,
+                    act_buf,
+                )
+                # Last virtual stage fuses its fwd into the bwd tick.
+                is_last = (stage == S - 1) & (v == V - 1)
+                y = lax.cond(
+                    valid_f & jnp.logical_not(is_last),
+                    lambda: run_block(chunk_params(v), x_in, key_for(v, m_f)),
+                    lambda: zeros_act,
+                )
+                fwd_send = lax.dynamic_update_index_in_dim(fwd_send, y, v, 0)
+
+                # ----------------------------------------- backward unit
+                tb = t - (2 * VS - sigma - 1)
+                valid_b = (tb >= 0) & (tb % 2 == 0) & (tb < 2 * M)
+                m_b = jnp.clip(tb // 2, 0, M - 1)
+                x_saved = lax.dynamic_index_in_dim(
+                    lax.dynamic_index_in_dim(act_buf, v, keepdims=False),
+                    m_b % VS, keepdims=False,
+                )
+                ym_b = lax.dynamic_index_in_dim(mb_labels, m_b, keepdims=False)
+                xm_b = lax.dynamic_index_in_dim(mb, m_b, keepdims=False)
+                key_b = key_for(v, m_b)
+                # Cotangent arriving for chunk v: device S-1 reads the
+                # wrap slot v+1, others read slot v.
+                bslot = jnp.where(stage == S - 1, min(v + 1, V - 1), v)
+                cot_in = lax.dynamic_index_in_dim(
+                    bwd_recv, bslot, keepdims=False
+                )
+
+                def last_bwd():
+                    def f(p_ch, p_ep, xin):
+                        h = run_block(p_ch, xin, key_b)
+                        logits = (
+                            self.epilogue(p_ep, h)
+                            if self.epilogue is not None else h
+                        )
+                        return self.loss(logits, ym_b), logits
+
+                    loss_m, pull, logits = jax.vjp(
+                        f, chunk_params(v), p_epi, x_saved, has_aux=True
+                    )
+                    d_ch, d_ep, dx = pull(jnp.asarray(1.0 / M, loss_m.dtype))
+                    return d_ch, d_ep, dx, loss_m, accuracy(logits, ym_b)
+
+                def mid_bwd():
+                    _, pull = jax.vjp(
+                        lambda p_ch, xin: run_block(p_ch, xin, key_b),
+                        chunk_params(v), x_saved,
+                    )
+                    d_ch, dx = pull(cot_in)
+                    return d_ch, zeros_epi, dx, jnp.zeros(()), jnp.zeros(())
+
+                def bwd_unit():
+                    d_ch, d_ep, dx, loss_m, acc_m = lax.cond(
+                        is_last, last_bwd, mid_bwd
+                    )
+
+                    def run_pro_p(p, xm):
+                        return (
+                            self.prologue(p, xm)
+                            if self.prologue is not None else xm
+                        )
+
+                    def pro_bwd():
+                        _, pull = jax.vjp(lambda p: run_pro_p(p, xm_b), p_pro)
+                        return pull(dx)[0]
+
+                    # The model input is virtual stage 0 = device 0 chunk 0.
+                    d_pro = lax.cond(
+                        (stage == 0) & (v == 0), pro_bwd, lambda: zeros_pro
+                    )
+                    return d_ch, d_pro, d_ep, dx, loss_m, acc_m
+
+                d_ch, d_pro, d_ep, dx, loss_m, acc_m = lax.cond(
+                    valid_b,
+                    bwd_unit,
+                    lambda: (
+                        jax.tree.map(
+                            lambda z: lax.dynamic_index_in_dim(
+                                z, v, keepdims=False
+                            ),
+                            zeros_chunks,
+                        ),
+                        zeros_pro, zeros_epi, zeros_act,
+                        jnp.zeros(()), jnp.zeros(()),
+                    ),
+                )
+                bwd_send = lax.dynamic_update_index_in_dim(bwd_send, dx, v, 0)
+                g_ch = jax.tree.map(
+                    lambda g, d, v=v: lax.dynamic_update_index_in_dim(
+                        g, lax.dynamic_index_in_dim(g, v, keepdims=False) + d,
+                        v, 0,
+                    ),
+                    g_ch, d_ch,
+                )
+                g_pro = jax.tree.map(jnp.add, g_pro, d_pro)
+                g_epi = jax.tree.map(jnp.add, g_epi, d_ep)
+                loss_sum = loss_sum + loss_m
+                acc_sum = acc_sum + acc_m
+
+            fwd_recv = ppermute_ring(fwd_send, axis, 1)
+            bwd_recv = ppermute_ring(bwd_send, axis, -1)
+            return (
+                act_buf, fwd_recv, bwd_recv, g_ch, g_pro, g_epi,
+                loss_sum, acc_sum,
+            ), None
+
+        n_ticks = 2 * (M + VS - 1)
+        init = (
+            jnp.zeros((V, VS) + zeros_act.shape, zeros_act.dtype),
+            jnp.zeros((V,) + zeros_act.shape, zeros_act.dtype),
+            jnp.zeros((V,) + zeros_act.shape, zeros_act.dtype),
+            zeros_chunks,
+            zeros_pro,
+            zeros_epi,
+            jnp.zeros(()),
+            jnp.zeros(()),
+        )
+        (_, _, _, g_ch, g_pro, g_epi, loss_sum, acc_sum), _ = lax.scan(
+            tick, init, jnp.arange(n_ticks)
+        )
+
+        grads = {
+            "prologue": psum_tree(g_pro, axis),
+            "stages": jax.tree.map(lambda g: g[None], g_ch),
+            "epilogue": psum_tree(g_epi, axis),
+        }
+        metrics = {
+            "loss": lax.psum(loss_sum, axis) / M,
+            "accuracy": lax.psum(acc_sum, axis) / M,
+        }
+        if self.batch_axis:
+            grads = pmean_tree(grads, self.batch_axis)
+            metrics = {
+                k: lax.pmean(v, self.batch_axis) for k, v in metrics.items()
+            }
+        new_params, new_opt = self.optimizer.update(grads, ts.opt_state, ts.params)
+        new_ts = TrainState(
+            params=new_params,
+            model_state=ts.model_state,
+            opt_state=new_opt,
+            step=ts.step + 1,
+        )
+        return new_ts, metrics
