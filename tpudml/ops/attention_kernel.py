@@ -358,8 +358,8 @@ def flash_forward_lse(
     *,
     causal: bool = False,
     k_shift: int = 0,
-    block_q: int = 128,
-    block_k: int = 512,
+    block_q: int | None = None,
+    block_k: int | None = None,
     interpret: bool = False,
 ) -> tuple[jax.Array, jax.Array]:
     """Flash forward that also returns the row log-sum-exp.
@@ -370,9 +370,13 @@ def flash_forward_lse(
     pass causal=False. ``k_shift=1`` makes the diagonal strict (the
     striped ring layout's later-device blocks).
     """
-    b, t, h, _ = q.shape
+    b, t, h, d = q.shape
+    (default_fwd_bq, _), default_bk = _default_blocks(d)
     out, lse = _flash_forward(
-        q, k, v, causal, block_q, block_k, interpret, k_shift=k_shift
+        q, k, v, causal,
+        default_fwd_bq if block_q is None else block_q,
+        default_bk if block_k is None else block_k,
+        interpret, k_shift=k_shift,
     )
     return out, lse[:, :t, 0].reshape(b, h, t)
 
@@ -387,8 +391,8 @@ def flash_block_grads(
     *,
     causal: bool = False,
     k_shift: int = 0,
-    block_q: int = 128,
-    block_k: int = 512,
+    block_q: int | None = None,
+    block_k: int | None = None,
     interpret: bool = False,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Per-block flash backward with EXTERNAL softmax statistics.
@@ -399,6 +403,11 @@ def flash_block_grads(
     blocks reproduces the full backward.
     """
     b, t, h, d = q.shape
+    (_, default_bwd_bq), default_bk = _default_blocks(d)
+    if block_q is None:
+        block_q = default_bwd_bq
+    if block_k is None:
+        block_k = default_bk
     block_q, block_k, t_pad_q, t_pad_k = _plan(t, block_q, block_k)
     qf, dof = _fold_pad((q, do), b, h, t, d, t_pad_q)
     kf, vf = _fold_pad((k, v), b, h, t, d, t_pad_k)
@@ -414,14 +423,20 @@ def flash_block_grads(
 # ------------------------------------------------------------- dispatch
 
 
-# Forward wants the largest Q tile that fits VMEM (fewer grid programs,
-# bigger MXU ops: 0.43 vs 0.71 ms/layer at T=1024 dh=64 on v5e for
-# (512,512) vs (128,512)); the backward's dQ/dKdV kernels carry more
-# scratch and live values per program and measure FASTER at the smaller
-# Q tile ((128,512): 1.6 ms vs (512,512): 3.0 ms bwd-only, same sweep).
-_FWD_BLOCK_Q = 512
-_BWD_BLOCK_Q = 128
-_DEFAULT_BLOCK_K = 512
+# Measured-best default tiles by head dim (v5e, T=1024 sweeps):
+# - forward wants the largest Q tile that fits VMEM (fewer grid
+#   programs, bigger MXU ops: 0.43 vs 0.71 ms/layer at dh=64 for
+#   (512,512) vs (128,512));
+# - the backward's dQ/dKdV kernels carry more scratch/live values per
+#   program and prefer smaller Q tiles;
+# - at dh>=128 (full-lane tiles) larger K blocks win in BOTH directions
+#   (fwd 0.067 ms at bk=1024 vs 0.131 at 512; bwd (256,1024) 0.56 ms vs
+#   (128,512) 0.90 ms per layer).
+def _default_blocks(d: int) -> tuple[tuple[int, int], int]:
+    """((fwd_block_q, bwd_block_q), block_k) by head dim."""
+    if d >= 128:
+        return (512, 256), 1024
+    return (512, 128), 512
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
@@ -461,7 +476,7 @@ def flash_attention(
     *,
     causal: bool = False,
     block_q: int | tuple[int, int] | None = None,
-    block_k: int = _DEFAULT_BLOCK_K,
+    block_k: int | None = None,
     interpret: bool | None = None,
     blocked_backward: bool = True,
 ) -> jax.Array:
@@ -471,17 +486,21 @@ def flash_attention(
     ``interpret=True`` forces the Pallas interpreter (tests).
 
     ``block_q``: one int for both directions, or a (forward, backward)
-    pair; None picks the measured-best per-direction defaults (the
-    forward prefers large Q tiles, the backward small — see module
-    constants). ``_plan`` still caps every block at the padded T."""
+    pair; ``block_q``/``block_k`` default (None) to the measured-best
+    tiles for the head dim (``_default_blocks``: the forward prefers
+    large Q tiles, the backward small; dh>=128 takes bigger K blocks).
+    ``_plan`` still caps every block at the padded T."""
     if interpret is None:
         if jax.default_backend() != "tpu":
             return dot_product_attention(q, k, v, causal=causal)
         interpret = False
+    default_bq, default_bk = _default_blocks(q.shape[-1])
     if block_q is None:
-        bq = (_FWD_BLOCK_Q, _BWD_BLOCK_Q)
+        bq = default_bq
     elif isinstance(block_q, int):
         bq = (block_q, block_q)
     else:
         bq = tuple(block_q)
+    if block_k is None:
+        block_k = default_bk
     return _flash(q, k, v, causal, bq, block_k, interpret, blocked_backward)
