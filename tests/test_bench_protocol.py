@@ -36,8 +36,7 @@ def test_peak_flops_by_device_kind():
 
 
 def test_time_fori_runs_and_is_positive():
-    """Tiny body through the real fori timer; the degenerate-measurement
-    fallback (t_hi <= t_lo) must yield an upper bound, never ~0."""
+    """Tiny body through the real fori timer (normal path)."""
 
     def body(ts, x, y):
         new = jax.tree.map(lambda a: a + 0.001 * x.sum(), ts)
@@ -46,3 +45,23 @@ def test_time_fori_runs_and_is_positive():
     ts = {"w": jnp.ones((8, 8))}
     sec = bench._time_fori(body, ts, (jnp.ones((4, 8)), jnp.ones((4, 8))), 2, 6)
     assert sec > 0 and sec < 10
+
+
+def test_time_fori_degenerate_fallback(monkeypatch):
+    """Force t_hi <= t_lo with a scripted clock: the fallback must return
+    the k_hi run INCLUDING overhead (an upper bound on sec/step), never a
+    difference-derived garbage value (the near-zero-headline trap the
+    round-2 review flagged)."""
+
+    def body(ts, x, y):
+        return ts, jnp.sum(x) - jnp.sum(y)
+
+    # Each timed(k) consumes two perf_counter() reads (start, end).
+    # Sequence: warm timed(2); t_lo = min of two timed(2) -> 5.0 each;
+    # t_hi = min of two timed(6) -> 1.0 each. 1.0 <= 5.0 triggers the
+    # fallback: sec = t_hi / k_hi = 1/6.
+    deltas = iter([0.0, 0.1, 10.0, 15.0, 30.0, 35.0, 50.0, 51.0, 60.0, 61.0])
+    monkeypatch.setattr(bench.time, "perf_counter", lambda: next(deltas))
+    ts = {"w": jnp.ones((4, 4))}
+    sec = bench._time_fori(body, ts, (jnp.ones((2, 4)), jnp.ones((2, 4))), 2, 6)
+    assert abs(sec - 1.0 / 6) < 1e-9
