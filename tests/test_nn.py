@@ -6,7 +6,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpudml.models import ForwardMLP, LeNet, lenet_stages
-from tpudml.nn import BatchNorm, Conv2D, Dense, Dropout, MaxPool, Sequential
+from tpudml.nn import BatchNorm, Conv2D, Dense, Dropout, LayerNorm, MaxPool, Sequential
 
 
 def test_dense_shapes():
@@ -86,3 +86,17 @@ def test_sequential_threads_rng_and_state():
         params, state, jnp.ones((2, 4)), train=True, rng=jax.random.key(1)
     )
     assert "layer2" in new_state
+
+
+def test_layernorm_large_mean_rows_stay_finite():
+    """Single-pass moments (E[x²]−m²) cancel catastrophically in f32 when
+    m² >> var; the clamp must keep rsqrt finite (review r3 finding)."""
+    import jax
+
+    ln = LayerNorm(512)
+    p, _ = ln.init(jax.random.PRNGKey(0))
+    x = jnp.full((4, 512), 300.0) + 1e-3 * jax.random.normal(
+        jax.random.PRNGKey(1), (4, 512)
+    )
+    y, _ = ln.apply(p, {}, x)
+    assert np.all(np.isfinite(np.asarray(y)))
