@@ -11,11 +11,13 @@ from tpudml.ops.attention_kernel import (
     flash_block_grads,
     flash_forward_lse,
 )
+from tpudml.ops.layernorm_kernel import fused_layernorm
 from tpudml.ops.xent_kernel import linear_cross_entropy
 
 __all__ = [
     "flash_attention",
     "flash_block_grads",
     "flash_forward_lse",
+    "fused_layernorm",
     "linear_cross_entropy",
 ]

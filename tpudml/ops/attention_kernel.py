@@ -40,8 +40,7 @@ from jax.experimental.pallas import tpu as pltpu
 from tpudml.nn.attention import NEG_INF, dot_product_attention
 
 
-def _round_up(x: int, m: int) -> int:
-    return -(-x // m) * m
+from tpudml.ops.tiling import round_up as _round_up  # shared tiling helper
 
 
 def _plan(t: int, block_q: int, block_k: int) -> tuple[int, int, int, int]:

@@ -1,0 +1,213 @@
+"""Fused LayerNorm (Pallas, TPU), forward + backward.
+
+MEASURED OUTCOME (round 3, v5e, [8192, 512] bf16) — read before using:
+in ISOLATION XLA's own LN is already near the bandwidth bound (fwd
+0.017 ms / fwd+bwd 0.078 ms vs this kernel's ~0.24-0.29 for either —
+the two kernel numbers sit within run-to-run jitter of each other), and
+swapping this kernel into the flagship LM step made the step SLOWER
+(26.1 vs 25.0 ms): the 4.4 ms/step in-situ "LN cost" (BASELINE.md
+ablation) is the price of the norm's reductions breaking XLA's
+producer/consumer fusion, and an opaque Pallas call is a HARDER fusion
+barrier, not a softer one. This kernel therefore stays an unplugged
+primitive: the validated, tested base for the actual next lever — an
+LN+residual(+matmul-epilogue) fusion kernel that absorbs the neighbors
+the XLA norm currently fuses with. Per direction it does ONE pass over
+row tiles:
+
+- forward: per [block_n, d] tile compute row mean and rstd in f32, emit
+  y = (x − m)·rstd·γ + β plus the (mean, rstd) row statistics as
+  residuals — O(N) extra memory, no recompute in the backward.
+- backward: the standard LN chain in one kernel —
+    g   = dy·γ
+    dx  = rstd · (g − mean_row(g) − x̂ · mean_row(g·x̂))
+  with dγ = Σ_rows dy·x̂ and dβ = Σ_rows dy accumulated in VMEM scratch
+  across row tiles (grid iterates row blocks; the [1, d] partials are
+  revisited consecutively and written once at the end).
+
+Exactness: matches the reference LayerNorm (f32 statistics, clamped-var
+single-pass moments are irrelevant here — mean/var come from the same
+single pass) to float tolerance; pinned by tests against
+``tpudml.nn.layers.LayerNorm`` in interpret mode and on the real chip.
+Dispatch: compiled kernel on TPU; reference math elsewhere unless
+``interpret=True`` (tests).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+from tpudml.ops.tiling import round_up as _round_up  # shared tiling helper
+
+
+def _fwd_kernel(x_ref, g_ref, b_ref, y_ref, mean_ref, rstd_ref, *, eps: float):
+    xf = x_ref[:].astype(jnp.float32)
+    m = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.maximum(
+        jnp.mean(jnp.square(xf), axis=-1, keepdims=True) - jnp.square(m), 0.0
+    )
+    rstd = jax.lax.rsqrt(var + eps)
+    xhat = (xf - m) * rstd
+    y = xhat * g_ref[:].astype(jnp.float32) + b_ref[:].astype(jnp.float32)
+    y_ref[:] = y.astype(y_ref.dtype)
+    mean_ref[:] = m
+    rstd_ref[:] = rstd
+
+
+def _bwd_kernel(x_ref, g_ref, dy_ref, mean_ref, rstd_ref, dx_ref, dg_ref,
+                db_ref, dg_acc, db_acc):
+    ni = pl.program_id(1)
+    nn = pl.num_programs(1)
+
+    @pl.when(ni == 0)
+    def _():
+        dg_acc[:] = jnp.zeros_like(dg_acc)
+        db_acc[:] = jnp.zeros_like(db_acc)
+
+    xf = x_ref[:].astype(jnp.float32)
+    dy = dy_ref[:].astype(jnp.float32)
+    rstd = rstd_ref[:]
+    xhat = (xf - mean_ref[:]) * rstd
+    gam = g_ref[:].astype(jnp.float32)
+
+    gy = dy * gam
+    mean_gy = jnp.mean(gy, axis=-1, keepdims=True)
+    mean_gyx = jnp.mean(gy * xhat, axis=-1, keepdims=True)
+    dx = rstd * (gy - mean_gy - xhat * mean_gyx)
+    dx_ref[:] = dx.astype(dx_ref.dtype)
+
+    dg_acc[:] += jnp.sum(dy * xhat, axis=0, keepdims=True)
+    db_acc[:] += jnp.sum(dy, axis=0, keepdims=True)
+
+    @pl.when(ni == nn - 1)
+    def _():
+        dg_ref[:] = dg_acc[:].astype(dg_ref.dtype)
+        db_ref[:] = db_acc[:].astype(db_ref.dtype)
+
+
+from tpudml.ops.tiling import pad_rows as _pad_rows  # shared tiling helper
+
+
+def _ln_forward(x, g, b, eps, block_n, interpret):
+    n, d = x.shape
+    block_n = min(block_n, _round_up(n, 8))
+    n_pad = _round_up(n, block_n)
+    xf = _pad_rows(x, n_pad)
+    y, mean, rstd = pl.pallas_call(
+        partial(_fwd_kernel, eps=eps),
+        out_shape=[
+            jax.ShapeDtypeStruct(xf.shape, x.dtype),
+            jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
+            jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
+        ],
+        grid=(n_pad // block_n,),
+        in_specs=[
+            pl.BlockSpec((block_n, d), lambda i: (i, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_n, d), lambda i: (i, 0)),
+            pl.BlockSpec((block_n, 1), lambda i: (i, 0)),
+            pl.BlockSpec((block_n, 1), lambda i: (i, 0)),
+        ],
+        interpret=interpret,
+    )(xf, g[None, :], b[None, :])
+    return y[:n], mean, rstd
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ln(x, g, b, eps, block_n, interpret):
+    y, _, _ = _ln_forward(x, g, b, eps, block_n, interpret)
+    return y
+
+
+def _ln_fwd(x, g, b, eps, block_n, interpret):
+    y, mean, rstd = _ln_forward(x, g, b, eps, block_n, interpret)
+    # b rides along only for its dtype: the bias cotangent must match the
+    # PRIMAL bias aval (scale and bias dtypes may differ).
+    return y, (x, g, b, mean, rstd)
+
+
+def _ln_bwd(eps, block_n, interpret, res, dy):
+    x, g, b, mean, rstd = res
+    n, d = x.shape
+    block_n = min(block_n, _round_up(n, 8))
+    n_pad = _round_up(n, block_n)
+    xf = _pad_rows(x, n_pad)
+    dyf = _pad_rows(dy, n_pad)
+    # Padded rows: dy rows are zero after padding, mean/rstd already
+    # cover n_pad (forward produced them); zero dy -> zero dx/dg/db
+    # contributions regardless of the statistics' padded values.
+    dx, dg, db = pl.pallas_call(
+        _bwd_kernel,
+        out_shape=[
+            jax.ShapeDtypeStruct(xf.shape, x.dtype),
+            jax.ShapeDtypeStruct((1, d), jnp.float32),
+            jax.ShapeDtypeStruct((1, d), jnp.float32),
+        ],
+        grid=(1, n_pad // block_n),
+        in_specs=[
+            pl.BlockSpec((block_n, d), lambda _, i: (i, 0)),
+            pl.BlockSpec((1, d), lambda _, i: (0, 0)),
+            pl.BlockSpec((block_n, d), lambda _, i: (i, 0)),
+            pl.BlockSpec((block_n, 1), lambda _, i: (i, 0)),
+            pl.BlockSpec((block_n, 1), lambda _, i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_n, d), lambda _, i: (i, 0)),
+            pl.BlockSpec((1, d), lambda _, i: (0, 0)),
+            pl.BlockSpec((1, d), lambda _, i: (0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((1, d), jnp.float32),
+            pltpu.VMEM((1, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xf, g[None, :], dyf, mean, rstd)
+    return dx[:n], dg[0].astype(g.dtype), db[0].astype(b.dtype)
+
+
+_ln.defvjp(_ln_fwd, _ln_bwd)
+
+
+def fused_layernorm(
+    x: jax.Array,
+    scale: jax.Array,
+    bias: jax.Array,
+    *,
+    eps: float = 1e-5,
+    block_n: int = 256,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """LayerNorm over the trailing axis with fused one-pass forward and
+    backward kernels (see module docstring). ``x`` [..., d] flattens to
+    rows; f32 statistics regardless of dtype; same math as
+    ``tpudml.nn.layers.LayerNorm``. Dispatches to the reference formula
+    on non-TPU backends unless ``interpret=True``."""
+    d = x.shape[-1]
+    if scale.shape != (d,) or bias.shape != (d,):
+        raise ValueError(
+            f"scale/bias {scale.shape}/{bias.shape} must be ({d},)"
+        )
+    if interpret is None:
+        if jax.default_backend() != "tpu":
+            xf = x.astype(jnp.float32)
+            m = jnp.mean(xf, axis=-1, keepdims=True)
+            var = jnp.maximum(
+                jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+                - jnp.square(m),
+                0.0,
+            )
+            y = (xf - m) * jax.lax.rsqrt(var + eps)
+            y = y * scale.astype(jnp.float32) + bias.astype(jnp.float32)
+            return y.astype(x.dtype)
+        interpret = False
+    xn = x.reshape(-1, d)
+    y = _ln(xn, scale, bias, eps, block_n, interpret)
+    return y.reshape(x.shape)

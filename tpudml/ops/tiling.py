@@ -1,0 +1,20 @@
+"""Shared tiling helpers for the Pallas kernels (flash attention, fused
+linear-cross-entropy, fused LayerNorm): one definition of the block
+rounding and row-padding boilerplate so a tiling/padding fix (e.g. a
+different sublane multiple per dtype) lands everywhere at once."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def pad_rows(x, n_pad: int):
+    """Zero-pad the leading (row) axis of a 2-D array up to ``n_pad``."""
+    return (
+        jnp.pad(x, ((0, n_pad - x.shape[0]), (0, 0)))
+        if n_pad != x.shape[0] else x
+    )

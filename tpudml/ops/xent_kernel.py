@@ -35,8 +35,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-def _round_up(x: int, m: int) -> int:
-    return -(-x // m) * m
+from tpudml.ops.tiling import round_up as _round_up  # shared tiling helper
 
 
 # ---------------------------------------------------------------- forward
