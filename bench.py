@@ -259,9 +259,16 @@ def bench_transformer(on_tpu: bool) -> dict:
         # Master-weight mixed precision: f32 params (the optimizer state),
         # bf16 MXU compute, f32 norms/softmax/logits.
         compute_dtype=jnp.bfloat16 if on_tpu else None,
+        # Fused residual-add+LN junction kernels: measured 20.88 →
+        # 18.68 ms/step on v5e at this config (BASELINE.md round 4).
+        fused_ln=on_tpu,
     )
     opt = make_optimizer("adamw", 3e-4)
-    seqs = jnp.asarray(synthetic_lm(batch, seq_len + 1, cfg["vocab_size"], seed=1))
+    # synthetic_lm returns [n, seq_len+1] ALREADY (slice x/y from it) —
+    # passing seq_len+1 here would train at T = seq_len+1, a block-
+    # misaligned length that every flash kernel pads up per layer per
+    # direction (the r1-r3 recordings did exactly that: T=1025).
+    seqs = jnp.asarray(synthetic_lm(batch, seq_len, cfg["vocab_size"], seed=1))
     x, y = seqs[:, :-1], seqs[:, 1:]
 
     body = _make_step_body(model, opt)

@@ -32,7 +32,8 @@ def ref(x, w, y, b=None):
     ],
 )
 @pytest.mark.parametrize("bias", [False, True])
-def test_matches_reference_loss_and_grads(n, d, v, bn, bv, bias):
+@pytest.mark.parametrize("save_s", [False, True])
+def test_matches_reference_loss_and_grads(n, d, v, bn, bv, bias, save_s):
     key = jax.random.PRNGKey(0)
     x = jax.random.normal(key, (n, d), jnp.float32)
     w = jax.random.normal(key, (d, v), jnp.float32) * 0.1
@@ -40,7 +41,7 @@ def test_matches_reference_loss_and_grads(n, d, v, bn, bv, bias):
     y = jax.random.randint(key, (n,), 0, v)
 
     fused = lambda x, w, b: linear_cross_entropy(
-        x, w, y, b, block_n=bn, block_v=bv, interpret=True
+        x, w, y, b, block_n=bn, block_v=bv, interpret=True, save_s=save_s
     )
     np.testing.assert_allclose(
         float(fused(x, w, b)), float(ref(x, w, y, b)), rtol=1e-6, atol=1e-6
@@ -88,6 +89,34 @@ def test_fused_lm_train_step_learns():
         losses.append(float(m["loss"]))
     assert losses[-1] < 0.5 < losses[0]
     assert int(ts.step) == 40
+
+
+def test_save_s_out_of_range_labels_and_padded_rows():
+    """The save-s backward must keep the padded-row/-column semantics of
+    the lean backward: zero dlogits on padded rows (lse re-padded +inf),
+    no pull-up for labels landing in [V, V_pad)."""
+    key = jax.random.PRNGKey(3)
+    n, d, v = 10, 16, 100  # rows pad to 16, vocab pads to 128
+    x = jax.random.normal(key, (n, d), jnp.float32)
+    w = jax.random.normal(key, (d, v), jnp.float32) * 0.1
+    y = jnp.array([0, 5, 99, 100, 110, 127, 3000, -7, 1, 2], jnp.int32)
+    args = dict(block_n=16, block_v=128, interpret=True)
+    loss_s = linear_cross_entropy(x, w, y, save_s=True, **args)
+    loss_l = linear_cross_entropy(x, w, y, save_s=False, **args)
+    np.testing.assert_allclose(float(loss_s), float(loss_l), rtol=1e-6)
+    for i in (0, 1):
+        gs = jax.grad(
+            lambda x, w: linear_cross_entropy(x, w, y, save_s=True, **args),
+            argnums=i,
+        )(x, w)
+        gl = jax.grad(
+            lambda x, w: linear_cross_entropy(x, w, y, save_s=False, **args),
+            argnums=i,
+        )(x, w)
+        assert np.all(np.isfinite(np.asarray(gs)))
+        np.testing.assert_allclose(
+            np.asarray(gs), np.asarray(gl), rtol=1e-6, atol=1e-7
+        )
 
 
 def test_out_of_range_labels_give_lse_loss_not_inf():

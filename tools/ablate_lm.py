@@ -26,14 +26,15 @@ from tpudml.train import TrainState
 
 
 def run(name, batch=8, seq_len=1024, vocab=32768, heads=8, layers=6,
-        dim=512, impl="flash", remat=False):
+        dim=512, impl="flash", remat=False, fused_ln=False):
     model = TransformerLM(
         vocab_size=vocab, embed_dim=dim, num_heads=heads, num_layers=layers,
         max_len=seq_len, impl=impl, rope=True, remat=remat,
-        compute_dtype=jnp.bfloat16,
+        compute_dtype=jnp.bfloat16, fused_ln=fused_ln,
     )
     opt = make_optimizer("adamw", 3e-4)
-    seqs = jnp.asarray(synthetic_lm(batch, seq_len + 1, vocab, seed=1))
+    # synthetic_lm returns [n, seq_len+1] already; x/y slices give T=seq_len.
+    seqs = jnp.asarray(synthetic_lm(batch, seq_len, vocab, seed=1))
     x, y = seqs[:, :-1], seqs[:, 1:]
     body = _make_step_body(model, opt)
     ts0 = TrainState.create(model, opt, seed_key(0))
@@ -63,5 +64,7 @@ if __name__ == "__main__":
         run("B=32", batch=32)
     if "h4" in which:
         run("heads=4 (dh=128)", heads=4)
+    if "h4fusedln" in which:
+        run("heads=4 + fused add+LN junctions", heads=4, fused_ln=True)
     if "b32v512" in which:
         run("B=32 V=512", batch=32, vocab=512)

@@ -109,6 +109,39 @@ def main():
             x,
         )
 
+    if "addln" in which:
+        from tpudml.nn.layers import LayerNorm
+        from tpudml.ops.layernorm_kernel import fused_add_layernorm
+
+        ln = LayerNorm(d_model)
+        p, _ = ln.init(key)
+        r = jax.random.normal(key, (B, T, d_model), jnp.bfloat16)
+
+        def chain_xla(s, r):
+            for _ in range(12):
+                s = s + r
+                y = ln(p, s)
+                r = y * 0.5  # stand-in branch: keeps the junctions chained
+            return s
+
+        def chain_fused(s, r):
+            for _ in range(12):
+                s, y = fused_add_layernorm(s, r, p["scale"], p["bias"])
+                r = y * 0.5
+            return s
+
+        time_fn("12x (add+LN) chain fwd  XLA", chain_xla, x, r)
+        time_fn("12x (add+LN) chain fwd  fused", chain_fused, x, r)
+        for name, fn in (("XLA", chain_xla), ("fused", chain_fused)):
+            time_fn(
+                f"12x (add+LN) chain fwd+bwd {name}",
+                jax.grad(
+                    lambda s, r, fn=fn: jnp.sum(fn(s, r).astype(jnp.float32)),
+                    argnums=(0, 1),
+                ),
+                x, r,
+            )
+
     if "flash" in which:
         from tpudml.nn.attention import dot_product_attention
         from tpudml.ops.attention_kernel import flash_attention

@@ -237,6 +237,17 @@ class TransformerLM(Module):
     moe_capacity_factor: float = 2.0
     moe_top_k: int = 1
     dtype: Any = jnp.float32
+    # Fused residual-add + LayerNorm junctions (tpudml.ops.layernorm_kernel
+    # .fused_add_layernorm): the trunk defers each block's closing residual
+    # add into the NEXT norm's kernel, so all 2L adds and 2L of the 2L+1
+    # norms run as one Pallas kernel per direction with the backward's
+    # residual-gradient merge folded in (round-3 ablation: the in-situ LN
+    # cost is fusion structure, not arithmetic — BASELINE.md). Identical
+    # math to the unfused path (the sum rounds to the stream dtype before
+    # the f32 statistics); dense-FFN blocks only (MoE keeps the unfused
+    # trunk). On non-TPU backends the op dispatches to reference math, so
+    # the flag is safe everywhere.
+    fused_ln: bool = False
     # Mixed precision, ResNet-style: parameters stay in ``dtype`` (the f32
     # master copy the optimizer updates) and are cast per-apply to
     # ``compute_dtype`` so the matmuls hit the MXU at bf16 throughput.
@@ -332,8 +343,60 @@ class TransformerLM(Module):
                 new_state[f"block{i}"] = s
         return h, new_state
 
+    def _trunk_deferred(self, params, tokens, train, rng):
+        """Fused-junction trunk (``fused_ln=True``, dense FFN only): embed
+        → blocks with each residual add deferred into the next norm's
+        fused add+LN kernel. Returns ``(s, pend)`` — the residual stream
+        and the still-unadded final FFN branch — so the caller can close
+        the last junction inside the final-norm fusion too."""
+        from tpudml.ops.layernorm_kernel import fused_add_layernorm
+
+        embed_keys = ("tok_embed",) + (() if self.rope else ("pos_embed",))
+        s = self._embed()({k: params[k] for k in embed_keys}, tokens)
+        block = self._block()
+        parts = block._parts()
+        pend = None
+        for i in range(self.num_layers):
+            p = params[f"block{i}"]
+            brng = None if rng is None else jax.random.fold_in(rng, i)
+            if pend is None:
+                y = parts["ln1"](p["ln1"], s)
+            else:
+                s, y = fused_add_layernorm(
+                    s, pend, p["ln1"]["scale"], p["ln1"]["bias"]
+                )
+            a = parts["attn"](p["attn"], y)
+            s, y2 = fused_add_layernorm(
+                s,
+                block._drop(a, train, brng, 1),
+                p["ln2"]["scale"],
+                p["ln2"]["bias"],
+            )
+            h = jax.nn.gelu(parts["fc1"](p["fc1"], y2))
+            pend = block._drop(parts["fc2"](p["fc2"], h), train, brng, 2)
+        return s, pend
+
+    def _features_deferred(self, params, tokens, train, rng):
+        """Deferred trunk closed through the final norm: the last block's
+        residual add fuses into ln_f."""
+        from tpudml.ops.layernorm_kernel import fused_add_layernorm
+
+        s, pend = self._trunk_deferred(params, tokens, train, rng)
+        _, y = fused_add_layernorm(
+            s, pend, params["ln_f"]["scale"], params["ln_f"]["bias"]
+        )
+        return y
+
+    def _use_fused_ln(self):
+        # num_layers=0 leaves no junction to fuse (pend would stay None).
+        return self.fused_ln and not self.moe_experts and self.num_layers > 0
+
     def apply(self, params, state, tokens, *, train=False, rng=None):
         params = self._cast_params(params)
+        if self._use_fused_ln():
+            y = self._features_deferred(params, tokens, train, rng)
+            head = Dense(self.embed_dim, self.vocab_size, dtype=self.dtype)
+            return head(params["head"], y), state
         h, new_state = self._trunk(params, state, tokens, train, rng)
         logits = self._head()({k: params[k] for k in ("ln_f", "head")}, h)
         # Logits stay in compute dtype: softmax_cross_entropy computes its
@@ -349,6 +412,8 @@ class TransformerLM(Module):
         consumes features + head weights and never materializes the
         [B·T, V] logits."""
         params = self._cast_params(params)
+        if self._use_fused_ln():
+            return self._features_deferred(params, tokens, train, rng), state
         h, new_state = self._trunk(params, state, tokens, train, rng)
         h = LayerNorm(self.embed_dim, dtype=self.dtype)(params["ln_f"], h)
         return h, new_state

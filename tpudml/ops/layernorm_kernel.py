@@ -45,8 +45,18 @@ from jax.experimental.pallas import tpu as pltpu
 from tpudml.ops.tiling import round_up as _round_up  # shared tiling helper
 
 
-def _fwd_kernel(x_ref, g_ref, b_ref, y_ref, mean_ref, rstd_ref, *, eps: float):
-    xf = x_ref[:].astype(jnp.float32)
+def _fwd_body(x_ref, r_ref, g_ref, b_ref, s_ref, y_ref, mean_ref, rstd_ref,
+              *, eps: float):
+    """Shared forward: optional residual add (r_ref/s_ref None = plain LN),
+    then f32 single-pass statistics and the affine normalize."""
+    if r_ref is not None:
+        sf = x_ref[:].astype(jnp.float32) + r_ref[:].astype(jnp.float32)
+        s = sf.astype(s_ref.dtype)
+        s_ref[:] = s
+        # Post-rounding, exactly as the unfused path sees the stream.
+        xf = s.astype(jnp.float32)
+    else:
+        xf = x_ref[:].astype(jnp.float32)
     m = jnp.mean(xf, axis=-1, keepdims=True)
     var = jnp.maximum(
         jnp.mean(jnp.square(xf), axis=-1, keepdims=True) - jnp.square(m), 0.0
@@ -59,8 +69,16 @@ def _fwd_kernel(x_ref, g_ref, b_ref, y_ref, mean_ref, rstd_ref, *, eps: float):
     rstd_ref[:] = rstd
 
 
-def _bwd_kernel(x_ref, g_ref, dy_ref, mean_ref, rstd_ref, dx_ref, dg_ref,
-                db_ref, dg_acc, db_acc):
+def _fwd_kernel(x_ref, g_ref, b_ref, y_ref, mean_ref, rstd_ref, *, eps: float):
+    _fwd_body(x_ref, None, g_ref, b_ref, None, y_ref, mean_ref, rstd_ref,
+              eps=eps)
+
+
+def _bwd_body(x_ref, g_ref, dy_ref, ds_ref, mean_ref, rstd_ref, dx_ref,
+              dg_ref, db_ref, dg_acc, db_acc):
+    """Shared backward: the LN input-gradient chain with dγ/dβ accumulated
+    in VMEM scratch across row tiles; ``ds_ref`` (None = plain LN) is the
+    downstream residual cotangent merged into dx in the same pass."""
     ni = pl.program_id(1)
     nn = pl.num_programs(1)
 
@@ -79,6 +97,8 @@ def _bwd_kernel(x_ref, g_ref, dy_ref, mean_ref, rstd_ref, dx_ref, dg_ref,
     mean_gy = jnp.mean(gy, axis=-1, keepdims=True)
     mean_gyx = jnp.mean(gy * xhat, axis=-1, keepdims=True)
     dx = rstd * (gy - mean_gy - xhat * mean_gyx)
+    if ds_ref is not None:
+        dx = dx + ds_ref[:].astype(jnp.float32)
     dx_ref[:] = dx.astype(dx_ref.dtype)
 
     dg_acc[:] += jnp.sum(dy * xhat, axis=0, keepdims=True)
@@ -88,6 +108,12 @@ def _bwd_kernel(x_ref, g_ref, dy_ref, mean_ref, rstd_ref, dx_ref, dg_ref,
     def _():
         dg_ref[:] = dg_acc[:].astype(dg_ref.dtype)
         db_ref[:] = db_acc[:].astype(db_ref.dtype)
+
+
+def _bwd_kernel(x_ref, g_ref, dy_ref, mean_ref, rstd_ref, dx_ref, dg_ref,
+                db_ref, dg_acc, db_acc):
+    _bwd_body(x_ref, g_ref, dy_ref, None, mean_ref, rstd_ref, dx_ref,
+              dg_ref, db_ref, dg_acc, db_acc)
 
 
 from tpudml.ops.tiling import pad_rows as _pad_rows  # shared tiling helper
@@ -174,6 +200,172 @@ def _ln_bwd(eps, block_n, interpret, res, dy):
 
 
 _ln.defvjp(_ln_fwd, _ln_bwd)
+
+
+# ------------------------------------------------- fused residual-add + LN
+#
+# Round-4 lever (VERDICT r3 item 1): the standalone LN kernel above loses
+# in-situ because an opaque Pallas call breaks XLA's producer/consumer
+# fusion around the norm. This variant absorbs the neighbors instead of
+# fighting them: at every residual junction ``s = x + r; y = LN(s)`` the
+# forward emits BOTH the new residual stream ``s`` and the normalized
+# ``y`` in one pass over the rows, and the backward folds the downstream
+# residual cotangent ``ds`` into the LN input-gradient in one pass:
+#
+#     gy = dy·γ
+#     dx = rstd · (gy − mean(gy) − ŝ·mean(gy·ŝ)) + ds      (= dr as well)
+#
+# so the whole junction — add, f32 casts, norm, and the backward's
+# gradient merge — is two kernels per direction instead of XLA's
+# reduce-broken fusion chains. Numerics match the reference composition
+# ``s = (x + r) in bf16; LayerNorm(s)`` exactly: the sum is rounded to
+# the stream dtype BEFORE the f32 statistics, like the unfused model.
+
+
+def _add_ln_fwd_kernel(x_ref, r_ref, g_ref, b_ref, s_ref, y_ref, mean_ref,
+                       rstd_ref, *, eps: float):
+    _fwd_body(x_ref, r_ref, g_ref, b_ref, s_ref, y_ref, mean_ref, rstd_ref,
+              eps=eps)
+
+
+def _add_ln_bwd_kernel(s_ref, g_ref, dy_ref, ds_ref, mean_ref, rstd_ref,
+                       dx_ref, dg_ref, db_ref, dg_acc, db_acc):
+    _bwd_body(s_ref, g_ref, dy_ref, ds_ref, mean_ref, rstd_ref, dx_ref,
+              dg_ref, db_ref, dg_acc, db_acc)
+
+
+def _add_ln_forward(x, r, g, b, eps, block_n, interpret):
+    n, d = x.shape
+    block_n = min(block_n, _round_up(n, 8))
+    n_pad = _round_up(n, block_n)
+    xf = _pad_rows(x, n_pad)
+    rf = _pad_rows(r, n_pad)
+    s, y, mean, rstd = pl.pallas_call(
+        partial(_add_ln_fwd_kernel, eps=eps),
+        out_shape=[
+            jax.ShapeDtypeStruct(xf.shape, x.dtype),
+            jax.ShapeDtypeStruct(xf.shape, x.dtype),
+            jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
+            jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
+        ],
+        grid=(n_pad // block_n,),
+        in_specs=[
+            pl.BlockSpec((block_n, d), lambda i: (i, 0)),
+            pl.BlockSpec((block_n, d), lambda i: (i, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_n, d), lambda i: (i, 0)),
+            pl.BlockSpec((block_n, d), lambda i: (i, 0)),
+            pl.BlockSpec((block_n, 1), lambda i: (i, 0)),
+            pl.BlockSpec((block_n, 1), lambda i: (i, 0)),
+        ],
+        interpret=interpret,
+    )(xf, rf, g[None, :], b[None, :])
+    return s[:n], y[:n], mean, rstd
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _add_ln(x, r, g, b, eps, block_n, interpret):
+    s, y, _, _ = _add_ln_forward(x, r, g, b, eps, block_n, interpret)
+    return s, y
+
+
+def _add_ln_fwd(x, r, g, b, eps, block_n, interpret):
+    s, y, mean, rstd = _add_ln_forward(x, r, g, b, eps, block_n, interpret)
+    return (s, y), (s, g, b, mean, rstd)
+
+
+def _add_ln_bwd(eps, block_n, interpret, res, cts):
+    ds, dy = cts
+    s, g, b, mean, rstd = res
+    n, d = s.shape
+    block_n = min(block_n, _round_up(n, 8))
+    n_pad = _round_up(n, block_n)
+    sf = _pad_rows(s, n_pad)
+    dyf = _pad_rows(dy, n_pad)
+    dsf = _pad_rows(ds, n_pad)
+    # Padded rows: dy and ds rows are zero after padding; mean/rstd cover
+    # n_pad from the forward; zero cotangents -> zero dx/dg/db there.
+    dx, dg, db = pl.pallas_call(
+        _add_ln_bwd_kernel,
+        out_shape=[
+            jax.ShapeDtypeStruct(sf.shape, s.dtype),
+            jax.ShapeDtypeStruct((1, d), jnp.float32),
+            jax.ShapeDtypeStruct((1, d), jnp.float32),
+        ],
+        grid=(1, n_pad // block_n),
+        in_specs=[
+            pl.BlockSpec((block_n, d), lambda _, i: (i, 0)),
+            pl.BlockSpec((1, d), lambda _, i: (0, 0)),
+            pl.BlockSpec((block_n, d), lambda _, i: (i, 0)),
+            pl.BlockSpec((block_n, d), lambda _, i: (i, 0)),
+            pl.BlockSpec((block_n, 1), lambda _, i: (i, 0)),
+            pl.BlockSpec((block_n, 1), lambda _, i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_n, d), lambda _, i: (i, 0)),
+            pl.BlockSpec((1, d), lambda _, i: (0, 0)),
+            pl.BlockSpec((1, d), lambda _, i: (0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((1, d), jnp.float32),
+            pltpu.VMEM((1, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(sf, g[None, :], dyf, dsf, mean, rstd)
+    dx = dx[:n]
+    # d(x) = d(r) = dx: the junction's sum distributes the cotangent to
+    # both addends unchanged; returning the same buffer twice costs no
+    # memory.
+    return dx, dx, dg[0].astype(g.dtype), db[0].astype(b.dtype)
+
+
+_add_ln.defvjp(_add_ln_fwd, _add_ln_bwd)
+
+
+def fused_add_layernorm(
+    x: jax.Array,
+    r: jax.Array,
+    scale: jax.Array,
+    bias: jax.Array,
+    *,
+    eps: float = 1e-5,
+    block_n: int = 256,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Residual-junction fusion: returns ``(s, y)`` with ``s = x + r``
+    (rounded to the stream dtype) and ``y = LayerNorm(s)`` computed in one
+    kernel per direction; the backward merges the downstream residual
+    cotangent of ``s`` into the LN input gradient (module comment above).
+    ``x``/``r`` [..., d]. Dispatches to the reference composition on
+    non-TPU backends unless ``interpret=True``."""
+    d = x.shape[-1]
+    if x.shape != r.shape:
+        raise ValueError(f"x {x.shape} != r {r.shape}")
+    if scale.shape != (d,) or bias.shape != (d,):
+        raise ValueError(
+            f"scale/bias {scale.shape}/{bias.shape} must be ({d},)"
+        )
+    if interpret is None:
+        if jax.default_backend() != "tpu":
+            s = x + r
+            sf = s.astype(jnp.float32)
+            m = jnp.mean(sf, axis=-1, keepdims=True)
+            var = jnp.maximum(
+                jnp.mean(jnp.square(sf), axis=-1, keepdims=True)
+                - jnp.square(m),
+                0.0,
+            )
+            y = (sf - m) * jax.lax.rsqrt(var + eps)
+            y = y * scale.astype(jnp.float32) + bias.astype(jnp.float32)
+            return s, y.astype(s.dtype)
+        interpret = False
+    xn = x.reshape(-1, d)
+    rn = r.reshape(-1, d)
+    s, y = _add_ln(xn, rn, scale, bias, eps, block_n, interpret)
+    return s.reshape(x.shape), y.reshape(x.shape)
 
 
 def fused_layernorm(

@@ -14,10 +14,20 @@ classifier head:
   softmax (m, l) per row plus the label's logit (fused iota-compare
   pick). Emits lse [N] and picked [N]; loss = mean(lse - picked).
   Residuals: x, W, labels, lse — O(N + params), NOT O(N·V).
-- backward: recompute s per tile; dlogits = (exp(s - lse) - onehot)·g/N.
-  Two kernels, mirroring the attention backward split:
+- backward, lean mode: recompute s per tile; dlogits =
+  (exp(s - lse) - onehot)·g/N. Two kernels, mirroring the attention
+  backward split:
   dX (V innermost): dx_tile += dlogits @ W_tileᵀ;
   dW (N innermost): dW_tile += x_tileᵀ @ dlogits.
+- backward, save-s mode (round 4; auto-selected when the [N, V] score
+  matrix fits ``save_s_bytes``): the forward additionally streams its
+  f32 score tiles to HBM, and both backward kernels read them instead of
+  recomputing — the backward drops from 4 matmuls' worth of MXU work to
+  the 2 the cotangents actually need (recomputing s cost ~2 ms at
+  [8192,512]×[512,32k]; XLA's lean path wins at memory-fitting sizes for
+  exactly this reason — it keeps the logits). Saved scores are f32, so
+  gradients are bit-identical to the lean mode's recomputation. Above
+  the budget the lean mode's O(N) memory story is unchanged.
 
 Exactness: same math as ``softmax_cross_entropy`` over the materialized
 logits (f32 statistics); pinned by tests against the XLA reference.
@@ -41,8 +51,8 @@ from tpudml.ops.tiling import round_up as _round_up  # shared tiling helper
 # ---------------------------------------------------------------- forward
 
 
-def _fwd_kernel(x_ref, w_ref, b_ref, label_ref, lse_ref, picked_ref, m_ref,
-                l_ref, z_ref, *, block_v: int, v_valid: int):
+def _fwd_body(x_ref, w_ref, b_ref, label_ref, lse_ref, picked_ref, m_ref,
+              l_ref, z_ref, s_ref, *, block_v: int, v_valid: int):
     vj = pl.program_id(1)
     nv = pl.num_programs(1)
 
@@ -60,6 +70,11 @@ def _fwd_kernel(x_ref, w_ref, b_ref, label_ref, lse_ref, picked_ref, m_ref,
     if v_valid != block_v * nv:
         # Padded vocab columns must carry no probability mass.
         s = jnp.where(col < v_valid, s, -jnp.inf)
+    if s_ref is not None:
+        # save-s mode: stream the masked f32 scores out; the backward
+        # reads them instead of recomputing the matmul (padded columns
+        # carry -inf → p = 0 there with no masking needed).
+        s_ref[:] = s
     label = label_ref[:]  # [bn, 1] int32
     # The pick must exclude padded columns even when a (buggy) label
     # lands in [V, V_pad): such labels see picked = 0 → loss = lse, the
@@ -82,7 +97,21 @@ def _fwd_kernel(x_ref, w_ref, b_ref, label_ref, lse_ref, picked_ref, m_ref,
         picked_ref[:] = z_ref[:]
 
 
-def _fused_forward(x, w, b, labels, block_n, block_v, interpret):
+def _fwd_kernel(x_ref, w_ref, b_ref, label_ref, lse_ref, picked_ref, m_ref,
+                l_ref, z_ref, *, block_v: int, v_valid: int):
+    _fwd_body(x_ref, w_ref, b_ref, label_ref, lse_ref, picked_ref, m_ref,
+              l_ref, z_ref, None, block_v=block_v, v_valid=v_valid)
+
+
+def _fwd_kernel_save(x_ref, w_ref, b_ref, label_ref, lse_ref, picked_ref,
+                     s_ref, m_ref, l_ref, z_ref, *, block_v: int,
+                     v_valid: int):
+    _fwd_body(x_ref, w_ref, b_ref, label_ref, lse_ref, picked_ref, m_ref,
+              l_ref, z_ref, s_ref, block_v=block_v, v_valid=v_valid)
+
+
+def _fused_forward(x, w, b, labels, block_n, block_v, interpret,
+                   save_s=False):
     n, d = x.shape
     d2, v = w.shape
     assert d == d2, (x.shape, w.shape)
@@ -95,12 +124,23 @@ def _fused_forward(x, w, b, labels, block_n, block_v, interpret):
     # Padded rows pick label -1 → match no column → picked 0, lse finite.
     lf = jnp.pad(labels.astype(jnp.int32), (0, n_pad - n),
                  constant_values=-1)[:, None]
-    lse, picked = pl.pallas_call(
-        partial(_fwd_kernel, block_v=block_v, v_valid=v),
-        out_shape=[
-            jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
-            jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
-        ],
+    out_shape = [
+        jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
+        jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
+    ]
+    out_specs = [
+        pl.BlockSpec((block_n, 1), lambda i, j: (i, 0)),
+        pl.BlockSpec((block_n, 1), lambda i, j: (i, 0)),
+    ]
+    if save_s:
+        out_shape.append(
+            jax.ShapeDtypeStruct((n_pad, v_pad), jnp.float32)
+        )
+        out_specs.append(pl.BlockSpec((block_n, block_v), lambda i, j: (i, j)))
+    outs = pl.pallas_call(
+        partial(_fwd_kernel_save if save_s else _fwd_kernel,
+                block_v=block_v, v_valid=v),
+        out_shape=out_shape,
         grid=(n_pad // block_n, v_pad // block_v),
         in_specs=[
             pl.BlockSpec((block_n, d), lambda i, j: (i, 0)),
@@ -108,10 +148,7 @@ def _fused_forward(x, w, b, labels, block_n, block_v, interpret):
             pl.BlockSpec((1, block_v), lambda i, j: (0, j)),
             pl.BlockSpec((block_n, 1), lambda i, j: (i, 0)),
         ],
-        out_specs=[
-            pl.BlockSpec((block_n, 1), lambda i, j: (i, 0)),
-            pl.BlockSpec((block_n, 1), lambda i, j: (i, 0)),
-        ],
+        out_specs=out_specs,
         scratch_shapes=[
             pltpu.VMEM((block_n, 1), jnp.float32),  # running max
             pltpu.VMEM((block_n, 1), jnp.float32),  # running normalizer
@@ -119,10 +156,155 @@ def _fused_forward(x, w, b, labels, block_n, block_v, interpret):
         ],
         interpret=interpret,
     )(xf, wf, bf, lf)
+    if save_s:
+        lse, picked, s = outs
+        return lse[:n, 0], picked[:n, 0], s
+    lse, picked = outs
     return lse[:n, 0], picked[:n, 0]
 
 
 # --------------------------------------------------------------- backward
+# save-s kernels: identical math to the lean kernels below, with the
+# score recomputation matmul replaced by a read of the forward's saved
+# f32 scores (padded columns already carry -inf → p = 0 unmasked).
+
+
+def _dx_s_kernel(s_ref, w_ref, label_ref, lse_ref, dx_ref, acc_ref, *,
+                 block_v: int, v_valid: int, inv_n: float):
+    vj = pl.program_id(1)
+    nv = pl.num_programs(1)
+
+    @pl.when(vj == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    s = s_ref[:]
+    col = vj * block_v + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    p = jnp.exp(s - lse_ref[:])
+    onehot = (col == label_ref[:]) & (col < v_valid)
+    dlog = (p - onehot.astype(jnp.float32)) * inv_n
+    acc_ref[:] += jax.lax.dot_general(
+        dlog.astype(w_ref.dtype), w_ref[:], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [bn, d]
+
+    @pl.when(vj == nv - 1)
+    def _():
+        dx_ref[:] = acc_ref[:].astype(dx_ref.dtype)
+
+
+def _dw_s_kernel(s_ref, x_ref, label_ref, lse_ref, dw_ref, db_ref, acc_ref,
+                 db_acc, *, block_v: int, v_valid: int, inv_n: float):
+    vj = pl.program_id(1)
+    ni = pl.program_id(2)
+    nn = pl.num_programs(2)
+
+    @pl.when(ni == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        db_acc[:] = jnp.zeros_like(db_acc)
+
+    s = s_ref[:]
+    col = vj * block_v + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    p = jnp.exp(s - lse_ref[:])
+    onehot = (col == label_ref[:]) & (col < v_valid)
+    dlog = (p - onehot.astype(jnp.float32)) * inv_n
+    acc_ref[:] += jax.lax.dot_general(
+        x_ref[:], dlog.astype(x_ref.dtype), (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [d, bv]
+    db_acc[:] += jnp.sum(dlog, axis=0, keepdims=True)
+
+    @pl.when(ni == nn - 1)
+    def _():
+        dw_ref[:] = acc_ref[:].astype(dw_ref.dtype)
+        db_ref[:] = db_acc[:].astype(db_ref.dtype)
+
+
+def _bwd_prologue(x, w, labels, lse, block_n, block_v):
+    """Shared backward setup for BOTH modes: block clamping and the
+    padded-row contract — labels pad to -1 (match no column) and lse
+    pads to +inf so p = exp(s − lse) = 0 on padded rows, making their
+    dlogits exactly zero in every backward kernel."""
+    n, d = x.shape
+    _, v = w.shape
+    block_n = min(block_n, _round_up(n, 8))
+    block_v = min(block_v, _round_up(v, 128))
+    n_pad, v_pad = _round_up(n, block_n), _round_up(v, block_v)
+    xf = jnp.pad(x, ((0, n_pad - n), (0, 0))) if n_pad != n else x
+    wf = jnp.pad(w, ((0, 0), (0, v_pad - v))) if v_pad != v else w
+    lf = jnp.pad(labels.astype(jnp.int32), (0, n_pad - n),
+                 constant_values=-1)[:, None]
+    lsef = jnp.pad(lse.astype(jnp.float32), (0, n_pad - n),
+                   constant_values=jnp.inf)[:, None]
+    return n, d, v, block_n, block_v, n_pad, v_pad, xf, wf, lf, lsef
+
+
+def _scale_cotangents(dx, dw, db, g, x, w, b):
+    """The scalar cotangent g is a traced value, so it cannot fold into
+    the kernels' static inv_n; 1/n scales inside, g multiplies outside
+    (one fused elementwise pass over dx/dW/db)."""
+    gf = g.astype(jnp.float32)
+    return (
+        (dx.astype(jnp.float32) * gf).astype(x.dtype),
+        (dw.astype(jnp.float32) * gf).astype(w.dtype),
+        (db * gf).astype(b.dtype),
+    )
+
+
+def _fused_backward_saved(x, w, b, labels, lse, s, g, block_n, block_v,
+                          interpret):
+    (n, d, v, block_n, block_v, n_pad, v_pad, xf, wf, lf, lsef
+     ) = _bwd_prologue(x, w, labels, lse, block_n, block_v)
+    assert s.shape == (n_pad, v_pad), (s.shape, n_pad, v_pad)
+    dx = pl.pallas_call(
+        partial(_dx_s_kernel, block_v=block_v, v_valid=v, inv_n=1.0 / n),
+        out_shape=jax.ShapeDtypeStruct(xf.shape, x.dtype),
+        grid=(n_pad // block_n, v_pad // block_v),
+        in_specs=[
+            pl.BlockSpec((block_n, block_v), lambda i, j: (i, j)),
+            pl.BlockSpec((d, block_v), lambda i, j: (0, j)),
+            pl.BlockSpec((block_n, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((block_n, 1), lambda i, j: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_n, d), lambda i, j: (i, 0)),
+        scratch_shapes=[pltpu.VMEM((block_n, d), jnp.float32)],
+        interpret=interpret,
+    )(s, wf, lf, lsef)[:n]
+    # dW tile cap: the f32 s tiles + f32 accumulator must fit scoped VMEM
+    # (~16 MB): 4·d·bv (acc) + 8·bn·bv (s ×2 buffers) + 8·d·bv (dw out
+    # ×2, f32 worst case) ≤ ~12 MB. Halve bv (staying a multiple of 128,
+    # so it still divides v_pad) until it fits.
+    bv_cap = max(
+        128, (12 * 1024 * 1024) // (12 * d + 8 * block_n) // 128 * 128
+    )
+    bv_dw = block_v
+    while bv_dw > bv_cap and bv_dw % 2 == 0 and (bv_dw // 2) % 128 == 0:
+        bv_dw //= 2
+    dw, db = pl.pallas_call(
+        partial(_dw_s_kernel, block_v=bv_dw, v_valid=v, inv_n=1.0 / n),
+        out_shape=[
+            jax.ShapeDtypeStruct(wf.shape, w.dtype),
+            jax.ShapeDtypeStruct((1, v_pad), jnp.float32),
+        ],
+        grid=(1, v_pad // bv_dw, n_pad // block_n),
+        in_specs=[
+            pl.BlockSpec((block_n, bv_dw), lambda _, j, i: (i, j)),
+            pl.BlockSpec((block_n, d), lambda _, j, i: (i, 0)),
+            pl.BlockSpec((block_n, 1), lambda _, j, i: (i, 0)),
+            pl.BlockSpec((block_n, 1), lambda _, j, i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((d, bv_dw), lambda _, j, i: (0, j)),
+            pl.BlockSpec((1, bv_dw), lambda _, j, i: (0, j)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((d, bv_dw), jnp.float32),
+            pltpu.VMEM((1, bv_dw), jnp.float32),
+        ],
+        interpret=interpret,
+    )(s, xf, lf, lsef)
+    return _scale_cotangents(dx, dw[:, :v], db[0, :v], g, x, w, b)
 
 
 def _dx_kernel(x_ref, w_ref, b_ref, label_ref, lse_ref, dx_ref, acc_ref, *,
@@ -188,29 +370,15 @@ def _dw_kernel(w_ref, x_ref, b_ref, label_ref, lse_ref, dw_ref, db_ref,
 
 
 def _fused_backward(x, w, b, labels, lse, g, block_n, block_v, interpret):
-    n, d = x.shape
-    _, v = w.shape
-    block_n = min(block_n, _round_up(n, 8))
-    block_v = min(block_v, _round_up(v, 128))
+    (n, d, v, block_n, block_v, n_pad, v_pad, xf, wf, lf, lsef
+     ) = _bwd_prologue(x, w, labels, lse, block_n, block_v)
     # The dW kernel holds a [d, block_v] f32 scratch PLUS double-buffered
     # [d, block_v] in/out W tiles; cap its vocab tile so the working set
     # stays under the ~16 MB scoped-VMEM limit (5 live [d, bv] f32 tiles
     # + x/dlog  ->  bv <= 12 MB / (5 * 4 * d)).
     bv_budget = max(128, (12 * 1024 * 1024) // (5 * 4 * d) // 128 * 128)
     block_v_dw = min(block_v, bv_budget)
-    n_pad, v_pad = _round_up(n, block_n), _round_up(v, block_v)
-    xf = jnp.pad(x, ((0, n_pad - n), (0, 0))) if n_pad != n else x
-    wf = jnp.pad(w, ((0, 0), (0, v_pad - v))) if v_pad != v else w
     bf = (jnp.pad(b, (0, v_pad - v)) if v_pad != v else b)[None, :]
-    lf = jnp.pad(labels.astype(jnp.int32), (0, n_pad - n),
-                 constant_values=-1)[:, None]
-    # Padded rows: lse=+inf → p = exp(s - inf) = 0 and no onehot match →
-    # dlogits exactly 0, so they contribute nothing to dx or dW.
-    lsef = jnp.pad(lse.astype(jnp.float32), (0, n_pad - n),
-                   constant_values=jnp.inf)[:, None]
-    # The scalar cotangent g is a traced value, so it cannot fold into
-    # the kernels' static inv_n; 1/n scales inside, g multiplies outside
-    # (one fused elementwise pass over dx/dW/db).
     dx = pl.pallas_call(
         partial(_dx_kernel, block_v=block_v, v_valid=v, inv_n=1.0 / n),
         out_shape=jax.ShapeDtypeStruct(xf.shape, x.dtype),
@@ -253,41 +421,49 @@ def _fused_backward(x, w, b, labels, lse, g, block_n, block_v, interpret):
         ],
         interpret=interpret,
     )(wfd, xf, bfd, lf, lsef)
-    dw = dw[:, :v]
-    db = db[0, :v]
-    gf = g.astype(jnp.float32)
-    return (
-        (dx.astype(jnp.float32) * gf).astype(x.dtype),
-        (dw.astype(jnp.float32) * gf).astype(w.dtype),
-        (db * gf).astype(b.dtype),
-    )
+    return _scale_cotangents(dx, dw[:, :v], db[0, :v], g, x, w, b)
 
 
 # --------------------------------------------------------------- dispatch
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def _fused(x, w, b, labels, block_n, block_v, interpret):
+@partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _fused(x, w, b, labels, block_n, block_v, interpret, save_s):
     lse, picked = _fused_forward(x, w, b, labels, block_n, block_v, interpret)
     return jnp.mean(lse - picked)
 
 
-def _fused_fwd(x, w, b, labels, block_n, block_v, interpret):
+def _fused_fwd(x, w, b, labels, block_n, block_v, interpret, save_s):
+    if save_s:
+        lse, picked, s = _fused_forward(
+            x, w, b, labels, block_n, block_v, interpret, save_s=True
+        )
+        return jnp.mean(lse - picked), (x, w, b, labels, lse, s)
     lse, picked = _fused_forward(x, w, b, labels, block_n, block_v, interpret)
-    return jnp.mean(lse - picked), (x, w, b, labels, lse)
+    return jnp.mean(lse - picked), (x, w, b, labels, lse, None)
 
 
-def _fused_bwd(block_n, block_v, interpret, res, g):
+def _fused_bwd(block_n, block_v, interpret, save_s, res, g):
     import numpy as np
 
-    x, w, b, labels, lse = res
-    dx, dw, db = _fused_backward(
-        x, w, b, labels, lse, g, block_n, block_v, interpret
-    )
+    x, w, b, labels, lse, s = res
+    if save_s:
+        dx, dw, db = _fused_backward_saved(
+            x, w, b, labels, lse, s, g, block_n, block_v, interpret
+        )
+    else:
+        dx, dw, db = _fused_backward(
+            x, w, b, labels, lse, g, block_n, block_v, interpret
+        )
     return dx, dw, db, np.zeros(labels.shape, dtype=jax.dtypes.float0)
 
 
 _fused.defvjp(_fused_fwd, _fused_bwd)
+
+# Auto save-s budget: keep the [N_pad, V_pad] f32 score residual when it
+# fits this many bytes (the backward then skips both recompute matmuls);
+# above it the lean recompute path keeps memory O(N).
+SAVE_S_MAX_BYTES = 2 << 30
 
 
 def linear_cross_entropy(
@@ -299,6 +475,7 @@ def linear_cross_entropy(
     block_n: int = 256,
     block_v: int = 2048,
     interpret: bool | None = None,
+    save_s: bool | None = None,
 ) -> jax.Array:
     """Mean softmax cross-entropy of ``x @ w [+ bias]`` against integer
     ``labels`` without materializing the [N, V] logits (see module
@@ -306,8 +483,11 @@ def linear_cross_entropy(
 
     ``x`` [..., d] flattens to [N, d]; ``labels`` [...] to [N]. Labels
     outside [0, V) contribute loss = lse (no pull-up) — mask such rows
-    out beforehand. On non-TPU backends dispatches to the XLA reference
-    math unless ``interpret=True`` forces the Pallas interpreter."""
+    out beforehand. ``save_s`` keeps the f32 scores as a backward
+    residual (2 fewer backward matmuls; O(N·V) memory) — default auto:
+    on when the residual fits ``SAVE_S_MAX_BYTES``. On non-TPU backends
+    dispatches to the XLA reference math unless ``interpret=True``
+    forces the Pallas interpreter."""
     d = x.shape[-1]
     v = w.shape[-1]
     xn = x.reshape(-1, d)
@@ -337,4 +517,8 @@ def linear_cross_entropy(
             return jnp.mean(lse - jnp.where(valid, picked, 0.0))
         interpret = False
     b = jnp.zeros((v,), w.dtype) if bias is None else bias
-    return _fused(xn, w, b, ln, block_n, block_v, interpret)
+    if save_s is None:
+        n_pad = _round_up(xn.shape[0], min(block_n, _round_up(xn.shape[0], 8)))
+        v_pad = _round_up(v, min(block_v, _round_up(v, 128)))
+        save_s = n_pad * v_pad * 4 <= SAVE_S_MAX_BYTES
+    return _fused(xn, w, b, ln, block_n, block_v, interpret, save_s)
