@@ -141,3 +141,99 @@ def test_validation_errors():
         HeteroPipeline(
             [Sequential((Dense(4, 4), BatchNorm(4))), Dense(4, 4)], 2, mesh, opt
         )
+    # prologue/epilogue would be silently dropped by the hetero schedule
+    # (stage 0 IS the prologue); rejected loudly instead.
+    with pytest.raises(TypeError, match="prologue"):
+        HeteroPipeline([Dense(4, 4), Dense(4, 4)], 2, mesh, opt,
+                       prologue=Dense(4, 4))
+
+
+# ------------------------------------------------------- hetero 1F1B
+
+
+def test_hetero_1f1b_train_step_matches_single_device(batch):
+    """The 1F1B schedule over the heterogeneous conv→fc split: first
+    update grad-exact vs the sequential single-device reference (VERDICT
+    r3 item 4 — S-bounded memory for the reference's actual MP workload)."""
+    from tpudml.parallel.pp import HeteroOneFOneB
+
+    x, y = batch
+    stages = [m for _, m in lenet_stages().stages]
+    mesh = make_mesh(MeshConfig({"stage": 2}), jax.devices()[:2])
+    opt = make_optimizer("sgd", 0.05, momentum=0.9)
+    pipe = HeteroOneFOneB(stages, n_microbatches=4, mesh=mesh, optimizer=opt)
+    ts = pipe.create_state(seed_key(1))
+    params0 = jax.device_get(ts.params)
+
+    new_ts, metrics = pipe.make_train_step()(ts, x, y)
+
+    # 1F1B sums per-micro mean losses / M — identical to the full-batch
+    # mean only when micro losses are equal-sized, as here.
+    M = 4
+    mb = x.reshape(M, -1, *x.shape[1:])
+    yb = y.reshape(M, -1)
+
+    def ref_loss(p):
+        total = 0.0
+        for mi in range(M):
+            total = total + softmax_cross_entropy(
+                pipe.sequential_forward(p, mb[mi]), yb[mi]
+            )
+        return total / M
+
+    loss0, ref_grads = jax.value_and_grad(ref_loss)(params0)
+    ref_params, _ = opt.update(ref_grads, opt.init(params0), params0)
+
+    np.testing.assert_allclose(float(metrics["loss"]), float(loss0), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(new_ts.params), jax.tree.leaves(ref_params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4,
+                                   atol=1e-6)
+
+
+def test_hetero_1f1b_dropout_grads_exact():
+    """Dropout through heterogeneous 1F1B stages (HeteroPipeline rejects
+    it; this engine lifts the restriction): gradients match a hand-built
+    single-device replica applying the SAME per-(stage, micro) keys."""
+    from tpudml.parallel.pp import HeteroOneFOneB
+    from tpudml.nn import Dropout
+
+    stages = [
+        Sequential((Dense(12, 48), Activation(jax.nn.relu), Dropout(0.5))),
+        Sequential((Dense(48, 10),)),
+    ]
+    mesh = make_mesh(MeshConfig({"stage": 2}), jax.devices()[:2])
+    opt = make_optimizer("sgd", 0.05)
+    rng_root = jax.random.key(7)
+    M = 4
+    pipe = HeteroOneFOneB(stages, n_microbatches=M, mesh=mesh,
+                          optimizer=opt, rng_root=rng_root)
+    rng = np.random.default_rng(11)
+    x = jnp.asarray(rng.normal(size=(8, 12)).astype(np.float32))
+    y = jnp.asarray(rng.integers(0, 10, size=(8,)).astype(np.int32))
+
+    ts = pipe.create_state(seed_key(3))
+    params0 = jax.device_get(ts.params)
+    new_ts, metrics = pipe.make_train_step()(ts, x, y)
+
+    step_key = jax.random.fold_in(rng_root, 0)
+    mb = x.reshape(M, -1, 12)
+    yb = y.reshape(M, -1)
+
+    def replica_loss(params):
+        total = 0.0
+        for mi in range(M):
+            h = mb[mi]
+            for s in range(2):
+                key = jax.random.fold_in(jax.random.fold_in(step_key, s), mi)
+                p_s = pipe._unravel(s, params["stages"][s])
+                h = pipe.stages[s].apply(p_s, {}, h, train=True, rng=key)[0]
+            total = total + softmax_cross_entropy(h, yb[mi])
+        return total / M
+
+    loss0, ref_grads = jax.value_and_grad(replica_loss)(params0)
+    ref_params, _ = opt.update(ref_grads, opt.init(params0), params0)
+
+    np.testing.assert_allclose(float(metrics["loss"]), float(loss0), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(new_ts.params), jax.tree.leaves(ref_params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4,
+                                   atol=1e-6)

@@ -26,7 +26,7 @@ from tpudml.train import TrainState
 
 
 def run(name, batch=8, seq_len=1024, vocab=32768, heads=8, layers=6,
-        dim=512, impl="flash", remat=False, fused_ln=False):
+        dim=512, impl="flash", remat=False, fused_ln=False, fused_xent=False):
     model = TransformerLM(
         vocab_size=vocab, embed_dim=dim, num_heads=heads, num_layers=layers,
         max_len=seq_len, impl=impl, rope=True, remat=remat,
@@ -36,7 +36,30 @@ def run(name, batch=8, seq_len=1024, vocab=32768, heads=8, layers=6,
     # synthetic_lm returns [n, seq_len+1] already; x/y slices give T=seq_len.
     seqs = jnp.asarray(synthetic_lm(batch, seq_len, vocab, seed=1))
     x, y = seqs[:, :-1], seqs[:, 1:]
-    body = _make_step_body(model, opt)
+    if fused_xent:
+        # Un-jitted fused-xent body (mirrors train.make_lm_fused_train_step)
+        # so _time_fori can wrap it in ONE dispatch.
+        from tpudml.ops.xent_kernel import linear_cross_entropy
+
+        def body(ts, tokens, labels):
+            def loss_fn(params, model_state):
+                feats, new_state = model.apply_features(
+                    params, model_state, tokens, train=True, rng=None
+                )
+                head = model._cast_params(params)["head"]
+                return linear_cross_entropy(
+                    feats, head["kernel"], labels, head.get("bias"),
+                    save_s=True,  # speed mode: V=32k fits comfortably
+                ), new_state
+
+            (loss, model_state), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(ts.params, ts.model_state)
+            new_params, new_opt = opt.update(grads, ts.opt_state, ts.params)
+            from tpudml.train import TrainState as TS
+            return TS(params=new_params, model_state=model_state,
+                      opt_state=new_opt, step=ts.step + 1), loss
+    else:
+        body = _make_step_body(model, opt)
     ts0 = TrainState.create(model, opt, seed_key(0))
     t0 = time.time()
     sec = _time_fori(body, ts0, (x, y), 8, 24)
@@ -66,5 +89,10 @@ if __name__ == "__main__":
         run("heads=4 (dh=128)", heads=4)
     if "h4fusedln" in which:
         run("heads=4 + fused add+LN junctions", heads=4, fused_ln=True)
+    if "h4fusedall" in which:
+        run("heads=4 + fused LN + fused xent", heads=4, fused_ln=True,
+            fused_xent=True)
+    if "h4fusedxent" in which:
+        run("heads=4 + fused xent (save-s)", heads=4, fused_xent=True)
     if "b32v512" in which:
         run("B=32 V=512", batch=32, vocab=512)

@@ -190,6 +190,22 @@ def main():
             "head matmul+xent fwd+bwd", jax.grad(head_loss, argnums=(0, 1)), W, x
         )
 
+        from tpudml.ops.xent_kernel import linear_cross_entropy
+
+        for mode in (False, True):
+            tag = "save-s" if mode else "lean"
+
+            def fused_loss(W, x, mode=mode):
+                return linear_cross_entropy(
+                    x.reshape(-1, d_model), W, y.reshape(-1), save_s=mode
+                )
+
+            time_fn(f"fused xent fwd ({tag})", fused_loss, W, x)
+            time_fn(
+                f"fused xent fwd+bwd ({tag})",
+                jax.grad(fused_loss, argnums=(0, 1)), W, x,
+            )
+
 
 if __name__ == "__main__":
     main()

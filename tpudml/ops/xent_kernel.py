@@ -19,15 +19,16 @@ classifier head:
   backward split:
   dX (V innermost): dx_tile += dlogits @ W_tileᵀ;
   dW (N innermost): dW_tile += x_tileᵀ @ dlogits.
-- backward, save-s mode (round 4; auto-selected when the [N, V] score
-  matrix fits ``save_s_bytes``): the forward additionally streams its
-  f32 score tiles to HBM, and both backward kernels read them instead of
-  recomputing — the backward drops from 4 matmuls' worth of MXU work to
-  the 2 the cotangents actually need (recomputing s cost ~2 ms at
-  [8192,512]×[512,32k]; XLA's lean path wins at memory-fitting sizes for
-  exactly this reason — it keeps the logits). Saved scores are f32, so
-  gradients are bit-identical to the lean mode's recomputation. Above
-  the budget the lean mode's O(N) memory story is unchanged.
+- backward, save-s mode (round 4; explicit ``save_s=True`` opt-in): the
+  forward additionally streams its f32 score tiles to HBM, and both
+  backward kernels read them instead of recomputing — the backward
+  drops from 4 matmuls' worth of MXU work to the 2 the cotangents
+  actually need (recomputing s cost ~2 ms at [8192,512]×[512,32k];
+  XLA's lean path wins at memory-fitting sizes for exactly this reason
+  — it keeps the logits). Saved scores are f32, so gradients are
+  bit-identical to the lean mode's recomputation. The trade is the O(N)
+  residual-memory contract above, which is why it is never a silent
+  default.
 
 Exactness: same math as ``softmax_cross_entropy`` over the materialized
 logits (f32 statistics); pinned by tests against the XLA reference.
@@ -460,11 +461,6 @@ def _fused_bwd(block_n, block_v, interpret, save_s, res, g):
 
 _fused.defvjp(_fused_fwd, _fused_bwd)
 
-# Auto save-s budget: keep the [N_pad, V_pad] f32 score residual when it
-# fits this many bytes (the backward then skips both recompute matmuls);
-# above it the lean recompute path keeps memory O(N).
-SAVE_S_MAX_BYTES = 2 << 30
-
 
 def linear_cross_entropy(
     x: jax.Array,
@@ -475,7 +471,7 @@ def linear_cross_entropy(
     block_n: int = 256,
     block_v: int = 2048,
     interpret: bool | None = None,
-    save_s: bool | None = None,
+    save_s: bool = False,
 ) -> jax.Array:
     """Mean softmax cross-entropy of ``x @ w [+ bias]`` against integer
     ``labels`` without materializing the [N, V] logits (see module
@@ -483,11 +479,13 @@ def linear_cross_entropy(
 
     ``x`` [..., d] flattens to [N, d]; ``labels`` [...] to [N]. Labels
     outside [0, V) contribute loss = lse (no pull-up) — mask such rows
-    out beforehand. ``save_s`` keeps the f32 scores as a backward
-    residual (2 fewer backward matmuls; O(N·V) memory) — default auto:
-    on when the residual fits ``SAVE_S_MAX_BYTES``. On non-TPU backends
-    dispatches to the XLA reference math unless ``interpret=True``
-    forces the Pallas interpreter."""
+    out beforehand. ``save_s=True`` is the SPEED mode: it keeps the
+    [N_pad, V_pad] f32 scores as a backward residual (2 fewer backward
+    matmuls — measured 8.0 → 5.7 ms at [8192,512]×[512,32k]) but gives
+    up this kernel's O(N) residual-memory contract, so it is an explicit
+    opt-in, never a silent default. On non-TPU backends dispatches to
+    the XLA reference math unless ``interpret=True`` forces the Pallas
+    interpreter."""
     d = x.shape[-1]
     v = w.shape[-1]
     xn = x.reshape(-1, d)
@@ -517,8 +515,4 @@ def linear_cross_entropy(
             return jnp.mean(lse - jnp.where(valid, picked, 0.0))
         interpret = False
     b = jnp.zeros((v,), w.dtype) if bias is None else bias
-    if save_s is None:
-        n_pad = _round_up(xn.shape[0], min(block_n, _round_up(xn.shape[0], 8)))
-        v_pad = _round_up(v, min(block_v, _round_up(v, 128)))
-        save_s = n_pad * v_pad * 4 <= SAVE_S_MAX_BYTES
     return _fused(xn, w, b, ln, block_n, block_v, interpret, save_s)

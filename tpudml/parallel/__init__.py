@@ -25,7 +25,13 @@ from tpudml.parallel.mp import (
     stage_sharding_rules,
     tensor_parallel_rules,
 )
-from tpudml.parallel.pp import GPipe, HeteroPipeline, Interleaved1F1B, OneFOneB
+from tpudml.parallel.pp import (
+    GPipe,
+    HeteroOneFOneB,
+    HeteroPipeline,
+    Interleaved1F1B,
+    OneFOneB,
+)
 
 __all__ = [
     "ContextParallel",
@@ -35,6 +41,7 @@ __all__ = [
     "FSDP",
     "fsdp_sharding_rules",
     "GPipe",
+    "HeteroOneFOneB",
     "HeteroPipeline",
     "Interleaved1F1B",
     "OneFOneB",

@@ -197,11 +197,16 @@ def make_lm_fused_train_step(
     model: Module,
     optimizer: Optimizer,
     rng_root: jax.Array | None = None,
+    save_scores: bool = False,
 ) -> Callable:
     """Jitted LM train step through the fused linear-cross-entropy kernel
     (``tpudml.ops.xent_kernel``): the [B·T, V] logits are never
     materialized — residual memory for the head drops from O(B·T·V) to
     O(B·T), the enabling trade for very long sequences / large vocabs.
+    ``save_scores=True`` trades that memory contract back for speed (the
+    kernel keeps an O(B·T·V) f32 score residual and skips both backward
+    recompute matmuls) — an explicit opt-in for memory-comfortable
+    configs; the default keeps the O(B·T) promise.
     The model must expose ``apply_features`` (TransformerLM) and a
     ``head`` Dense param subtree. Metrics carry loss only (no logits ⇒
     no accuracy; use the standard step when accuracy matters). MoE
@@ -217,7 +222,8 @@ def make_lm_fused_train_step(
         )
         head = model._cast_params(params)["head"]
         loss = linear_cross_entropy(
-            feats, head["kernel"], labels, head.get("bias")
+            feats, head["kernel"], labels, head.get("bias"),
+            save_s=save_scores,
         )
         if aux_w:
             loss = loss + aux_w * collect_aux_losses(new_state)
