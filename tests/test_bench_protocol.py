@@ -43,8 +43,11 @@ def test_time_fori_runs_and_is_positive():
         return new, jnp.sum(x) - jnp.sum(y)
 
     ts = {"w": jnp.ones((8, 8))}
-    sec = bench._time_fori(body, ts, (jnp.ones((4, 8)), jnp.ones((4, 8))), 2, 6)
+    sec, runs = bench._time_fori(
+        body, ts, (jnp.ones((4, 8)), jnp.ones((4, 8))), 2, 6, reps=3
+    )
     assert sec > 0 and sec < 10
+    assert len(runs) == 3 and sorted(runs)[1] == sec  # median of the reps
 
 
 def test_time_fori_degenerate_fallback(monkeypatch):
@@ -63,5 +66,8 @@ def test_time_fori_degenerate_fallback(monkeypatch):
     deltas = iter([0.0, 0.1, 10.0, 15.0, 30.0, 35.0, 50.0, 51.0, 60.0, 61.0])
     monkeypatch.setattr(bench.time, "perf_counter", lambda: next(deltas))
     ts = {"w": jnp.ones((4, 4))}
-    sec = bench._time_fori(body, ts, (jnp.ones((2, 4)), jnp.ones((2, 4))), 2, 6)
+    sec, runs = bench._time_fori(
+        body, ts, (jnp.ones((2, 4)), jnp.ones((2, 4))), 2, 6, reps=1
+    )
     assert abs(sec - 1.0 / 6) < 1e-9
+    assert runs == [sec]

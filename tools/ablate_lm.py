@@ -16,7 +16,12 @@ import jax
 import jax.numpy as jnp
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-from bench import _make_step_body, _time_fori, _compiled_flops, _peak_flops  # noqa: E402
+from bench import (  # noqa: E402
+    _analytic_lm_flops,
+    _make_step_body,
+    _peak_flops,
+    _time_fori,
+)
 
 from tpudml.core.prng import seed_key
 from tpudml.data.datasets import synthetic_lm
@@ -37,33 +42,26 @@ def run(name, batch=8, seq_len=1024, vocab=32768, heads=8, layers=6,
     seqs = jnp.asarray(synthetic_lm(batch, seq_len, vocab, seed=1))
     x, y = seqs[:, :-1], seqs[:, 1:]
     if fused_xent:
-        # Un-jitted fused-xent body (mirrors train.make_lm_fused_train_step)
-        # so _time_fori can wrap it in ONE dispatch.
-        from tpudml.ops.xent_kernel import linear_cross_entropy
+        from tpudml.train import make_lm_fused_train_step_body
+
+        # save_scores: speed mode, V=32k fits comfortably on this chip.
+        fb = make_lm_fused_train_step_body(model, opt, save_scores=True)
 
         def body(ts, tokens, labels):
-            def loss_fn(params, model_state):
-                feats, new_state = model.apply_features(
-                    params, model_state, tokens, train=True, rng=None
-                )
-                head = model._cast_params(params)["head"]
-                return linear_cross_entropy(
-                    feats, head["kernel"], labels, head.get("bias"),
-                    save_s=True,  # speed mode: V=32k fits comfortably
-                ), new_state
-
-            (loss, model_state), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(ts.params, ts.model_state)
-            new_params, new_opt = opt.update(grads, ts.opt_state, ts.params)
-            from tpudml.train import TrainState as TS
-            return TS(params=new_params, model_state=model_state,
-                      opt_state=new_opt, step=ts.step + 1), loss
+            new_ts, metrics = fb(ts, tokens, labels)
+            return new_ts, metrics["loss"]
     else:
         body = _make_step_body(model, opt)
     ts0 = TrainState.create(model, opt, seed_key(0))
     t0 = time.time()
-    sec = _time_fori(body, ts0, (x, y), 8, 24)
-    flops = _compiled_flops(jax.jit(body), ts0, x, y)
+    sec, _ = _time_fori(body, ts0, (x, y), 8, 24, reps=1)
+    # Analytic matmul FLOPs: XLA cost analysis can't see inside the
+    # Pallas custom calls, which would deflate exactly the fused rows
+    # this tool exists to compare (bench.py's _analytic_lm_flops note).
+    flops = _analytic_lm_flops(
+        dict(embed_dim=dim, num_layers=layers, vocab_size=vocab),
+        batch, seq_len,
+    )
     peak = _peak_flops(jax.devices()[0])
     mfu = flops / sec / peak if flops and peak else float("nan")
     tokens = batch * seq_len

@@ -1,0 +1,86 @@
+"""Lab1 deliverable recording: the GD / SGD / Adam convergence comparison
+the reference grades (sections/checking.tex:5-9, task1.tex:8-23 — compare
+first/second-order & deterministic/stochastic optimizer character).
+
+Runs tasks.task1 at a matched budget per optimizer on the current backend
+and prints a loss-trajectory table for BASELINE.md. Per-optimizer lr is
+tuned the way a student would (the reference's own lr rule is
+Adam-specific); the comparison is convergence CHARACTER, not lr fairness.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tasks.task1 import reference_defaults, run  # noqa: E402
+
+CONFIGS = [
+    # (label, optimizer, batch, epochs, lr, momentum): all rows at the
+    # reference's batch 200 (codes/task1/pytorch/model.py:96) and a
+    # shared epoch budget — how the reference lab itself compares them
+    # (its GdOptimizer also runs on DataLoader mini-batches; the
+    # deterministic-vs-stochastic axis is discussed in the analysis).
+    # A true full-batch (4096) GD row was attempted and DROPPED: the
+    # LeNet train step at batch >=1024 sits >9 minutes in XLA
+    # backend_compile through this environment's remote AOT helper on
+    # every attempt (batch-200 compiles in ~5 s; ResNet-18 at batch
+    # 1024 in ~40 s — it is large-batch-LeNet-specific).
+    ("gd (plain first-order)", "gd", 200, 8, 0.05, 0.0),
+    ("sgd + momentum 0.9", "sgd", 200, 8, 0.05, 0.9),
+    ("adam", "adam", 200, 8, 0.002, 0.0),
+    ("adam_ref (no bias corr.)", "adam_ref", 200, 8, 0.002, 0.0),
+]
+
+
+def loss_series(run_dir: Path) -> list[tuple[int, float]]:
+    out = []
+    with open(run_dir / "metrics.jsonl") as f:
+        for line in f:
+            rec = json.loads(line)
+            if rec.get("tag") == "Train Loss":
+                out.append((rec["step"], rec["value"]))
+    return out
+
+
+def main():
+    rows = []
+    for label, opt, batch, epochs, lr, momentum in CONFIGS:
+        cfg = reference_defaults()
+        cfg.optimizer = opt
+        cfg.lr = lr
+        cfg.momentum = momentum
+        cfg.epochs = epochs
+        cfg.data.batch_size = batch
+        cfg.data.dataset = "synthetic"
+        cfg.log_every = 1 if batch >= 4096 else 5
+        metrics = run(cfg)
+        run_dir = max(
+            (p for p in Path(cfg.log_dir).rglob("*task1-*") if p.is_dir()),
+            key=lambda p: p.stat().st_mtime,
+        )
+        series = loss_series(run_dir)
+        rows.append((label, batch, epochs, lr, series, metrics))
+
+    print("\n=== Lab1 optimizer comparison (copy to BASELINE.md) ===")
+    for label, batch, epochs, lr, series, metrics in rows:
+        vals = dict(series)
+        steps = sorted(vals)
+        picks = [steps[0]] + [
+            steps[min(len(steps) - 1, int(f * (len(steps) - 1)))]
+            for f in (0.1, 0.25, 0.5, 1.0)
+        ]
+        traj = " → ".join(f"{vals[s]:.4f}@{s}" for s in dict.fromkeys(picks))
+        print(
+            f"| {label} | b={batch} lr={lr} e={epochs} | {traj} | "
+            f"{metrics['test_accuracy'] * 100:.2f}% | "
+            f"{metrics.get('train_time_s', float('nan')):.1f}s |"
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -25,7 +25,14 @@ def softmax_cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
     the label pick is a fused where+sum instead of a TPU row-gather, and
     the backward recomputes softmax in one fused pass. Statistics are f32
     regardless of the logits dtype, so bf16 logits need no up-cast
-    materialization."""
+    materialization.
+
+    REVERSE-MODE ONLY (ADVICE r3): ``jax.custom_vjp`` does not support
+    forward-mode AD, so ``jax.jvp``/``jacfwd``/higher-order
+    differentiation through this loss raises. Every training path in the
+    framework is reverse-mode; if forward-mode is ever needed, compose
+    the same math inline (``_xent_fwd_value`` without the custom-vjp
+    wrapper) at the call site."""
     loss, _ = _xent_fwd_value(logits, labels)
     return loss
 
