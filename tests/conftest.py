@@ -20,6 +20,20 @@ from __graft_entry__ import _provision_cpu_mesh  # noqa: E402
 
 _provision_cpu_mesh(8)
 
+# Persistent XLA compilation cache (gitignored): the suite's cost on this
+# 1-core box is dominated by CPU XLA compiles, most of which repeat
+# identically across runs. The first (cold) run pays full compile; repeat
+# runs — the signal loop a developer actually sits in — reuse cached
+# executables. Numbers in pytest.ini.
+import jax  # noqa: E402
+
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".jax_test_cache"),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

@@ -33,7 +33,10 @@ def _clean_env():
 @pytest.mark.slow
 def test_entry_and_dryrun_from_clean_environment():
     """entry() must jit+run, then dryrun_multichip(8) must self-provision
-    and pass every regime — one subprocess, driver conditions."""
+    — one subprocess, driver conditions. Only a 2-regime subset runs here
+    (the subprocess's job is the clean-env PROVISIONING path; compiling
+    all 16 regimes cost 98 s and duplicated both the in-process full run
+    below and the driver's own round-end dryrun)."""
     proc = subprocess.run(
         [
             sys.executable,
@@ -44,7 +47,7 @@ def test_entry_and_dryrun_from_clean_environment():
                 "out = jax.jit(fn)(*args);"
                 "jax.block_until_ready(out);"
                 "print('entry ok', out.shape);"
-                "__graft_entry__.dryrun_multichip(8)"
+                "__graft_entry__.dryrun_multichip(8, regimes=('dp', 'hetero1f1b'))"
             ),
         ],
         cwd=REPO,
@@ -57,16 +60,7 @@ def test_entry_and_dryrun_from_clean_environment():
     assert "entry ok" in proc.stdout
     for regime in (
         "dp ok",
-        "dp x stage ok",
-        "pipeline ok",
-        "ring-attention cp ok",
-        "tensor-parallel ok",
-        "expert-parallel ok",
-        "fsdp ok",
-        "1f1b pipeline ok",
-        "pp x dp ok",
-        "hetero conv->fc pipeline ok",
-        "interleaved 1f1b ok",
+        "hetero 1f1b pipeline ok",
     ):
         assert regime in proc.stdout, f"missing regime '{regime}':\n{proc.stdout}"
 

@@ -225,7 +225,7 @@ def test_two_process_task5_e2e(tmp_path, parallel):
         [PY, "-m", "tasks.task5_longcontext", "--parallel", parallel,
          "--seq_len", "32", "--batch_size", "8", "--vocab", "32",
          "--embed_dim", "32", "--num_heads", "4", "--num_layers", "1",
-         "--steps", "30", "--lr", "0.01", "--log_every", "0"],
+         "--steps", "20", "--lr", "0.02", "--log_every", "0"],
         spec,
         sink=sink,
     )
@@ -255,7 +255,8 @@ def test_elastic_recovery_resumes_from_checkpoint(tmp_path):
     # Wrap task2: a train_loop hook kills rank 1 at step 48 (mid-epoch 2;
     # the 4096-sample synthetic set partitions to 2048/replica, so batch 64
     # = 32 steps/epoch) on the first attempt only. --ckpt_every 32 lands on
-    # the epoch boundary (resume granularity is whole epochs).
+    # the epoch boundary (resume granularity is whole epochs). 2 epochs is
+    # the minimum that crashes mid-epoch-2 and still resumes past it.
     code = (
         "import os, sys;"
         "import tpudml.train as T;"
@@ -270,7 +271,7 @@ def test_elastic_recovery_resumes_from_checkpoint(tmp_path):
         "    return orig(*a, **kw)\n"
         "T.train_loop = wrapped\n"
         "from tasks import task2;"
-        "task2.main(['--dataset', 'synthetic', '--epochs', '3',"
+        "task2.main(['--dataset', 'synthetic', '--epochs', '2',"
         " '--batch_size', '64', '--log_every', '0',"
         " '--ckpt_dir', " + repr(str(ckpt)) + ", '--ckpt_every', '32',"
         " '--resume'])"
@@ -282,10 +283,10 @@ def test_elastic_recovery_resumes_from_checkpoint(tmp_path):
     assert (tmp_path / "crashed-once.once").exists()  # the bomb DID fire
     accs = re.findall(r"Test accuracy: ([0-9.]+)%", out)
     assert len(accs) == 2 and len(set(accs)) == 1, out
-    # Resume reached the budgeted final step: 3 epochs x 32 steps.
+    # Resume reached the budgeted final step: 2 epochs x 32 steps.
     from tpudml.checkpoint import CheckpointManager
 
-    assert CheckpointManager(str(ckpt)).latest_step() == 96
+    assert CheckpointManager(str(ckpt)).latest_step() == 64
 
 
 def test_tpu_vm_command_builders():

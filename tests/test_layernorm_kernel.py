@@ -17,7 +17,9 @@ from tpudml.nn.layers import LayerNorm
 from tpudml.ops.layernorm_kernel import fused_layernorm
 
 
-@pytest.mark.parametrize("n,d,bn", [(16, 32, 8), (24, 16, 16), (10, 8, 8)])
+# (24,16,16) exercises block_n > n clamping; (10,8,8) added only
+# row padding on top of it — folded into the first case's odd n.
+@pytest.mark.parametrize("n,d,bn", [(10, 32, 8), (24, 16, 16)])
 def test_matches_reference_value_and_grads(n, d, bn):
     key = jax.random.PRNGKey(0)
     x = jax.random.normal(key, (n, d), jnp.float32) * 2 + 1
@@ -58,7 +60,7 @@ def _addln_ref(x, r, g, b):
     return s, LayerNorm(x.shape[-1]).apply({"scale": g, "bias": b}, {}, s)[0]
 
 
-@pytest.mark.parametrize("n,d,bn", [(16, 32, 8), (10, 16, 8)])
+@pytest.mark.parametrize("n,d,bn", [(10, 16, 8)])
 def test_add_ln_matches_reference(n, d, bn):
     from tpudml.ops.layernorm_kernel import fused_add_layernorm
 

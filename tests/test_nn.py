@@ -100,3 +100,27 @@ def test_layernorm_large_mean_rows_stay_finite():
     )
     y, _ = ln.apply(p, {}, x)
     assert np.all(np.isfinite(np.asarray(y)))
+
+
+def test_embed_lookup_matmul_backward_matches_scatter():
+    """embed_lookup's one-hot-matmul backward must equal autodiff's
+    scatter-add gradient exactly (same per-row cotangent sums), including
+    repeated tokens — the correctness contract behind swapping TPU
+    scatter (3.6 ms) for an MXU matmul (1.0 ms) at the flagship shapes."""
+    import jax
+
+    from tpudml.models.transformer import embed_lookup
+
+    E = jax.random.normal(jax.random.PRNGKey(0), (32, 8))
+    # Force repeats so multiple cotangent rows sum into one table row.
+    toks = jnp.asarray([[1, 1, 5, 31], [0, 1, 5, 5]], jnp.int32)
+    g = jax.random.normal(jax.random.PRNGKey(2), (2, 4, 8))
+
+    got = jax.grad(lambda E: jnp.sum(embed_lookup(E, toks) * g))(E)
+    want = jax.grad(lambda E: jnp.sum(E[toks] * g))(E)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+    # Forward is the plain gather.
+    np.testing.assert_array_equal(
+        np.asarray(embed_lookup(E, toks)), np.asarray(E[toks])
+    )

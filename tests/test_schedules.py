@@ -148,10 +148,12 @@ def test_ring_attention_remat_flag_compat():
     from tpudml.parallel.cp import ring_attention
     from tpudml.parallel.sharding import shard_map_fn
 
-    mesh = make_mesh(MeshConfig({"seq": 4}), jax.devices()[:4])
+    # seq 2 keeps the compile small — the flag-compat contract is the
+    # point here; ring math/grad parity lives in tests/test_cp.py.
+    mesh = make_mesh(MeshConfig({"seq": 2}), jax.devices()[:2])
     rng = np.random.default_rng(0)
     q, k, v = (
-        jnp.asarray(rng.normal(size=(2, 32, 4, 8)).astype(np.float32))
+        jnp.asarray(rng.normal(size=(2, 16, 4, 8)).astype(np.float32))
         for _ in range(3)
     )
     spec = P(None, "seq")

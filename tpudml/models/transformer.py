@@ -28,6 +28,46 @@ from tpudml.nn.attention import MultiHeadAttention, sharded_positions
 from tpudml.nn.layers import Dense, LayerNorm, Module
 
 
+@jax.custom_vjp
+def embed_lookup(table: jax.Array, tokens: jax.Array) -> jax.Array:
+    """Token-embedding gather with a matmul backward.
+
+    Forward is the plain gather ``table[tokens]``. The backward computes
+    dTable = one_hot(tokens)ᵀ @ dy as an MXU matmul instead of autodiff's
+    scatter-add: on v5e at [8·1024 tokens, 32k vocab, d=512] the
+    scatter-add path measures 3.6 ms vs 1.0 ms for the one-hot matmul
+    (tools/micro_lm.py embed) — TPU scatter serializes per-index updates
+    while the matmul is dense MXU work. Same math (each table row sums
+    the cotangents of its occurrences); f32 accumulation, cast to the
+    table dtype."""
+    return table[tokens]
+
+
+def _embed_lookup_fwd(table, tokens):
+    # The table rides along for its static shape/dtype only (a reference,
+    # not a copy — it is a live parameter either way).
+    return table[tokens], (tokens, table)
+
+
+def _embed_lookup_bwd(res, dy):
+    import numpy as np
+
+    tokens, table = res
+    oh = jax.nn.one_hot(tokens.reshape(-1), table.shape[0], dtype=dy.dtype)
+    d = dy.shape[-1]
+    dtable = lax.dot_general(
+        oh, dy.reshape(-1, d), (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return (
+        dtable.astype(table.dtype),
+        np.zeros(tokens.shape, dtype=jax.dtypes.float0),
+    )
+
+
+embed_lookup.defvjp(_embed_lookup_fwd, _embed_lookup_bwd)
+
+
 @dataclass(frozen=True)
 class TransformerBlock(Module):
     """Pre-LN decoder block: x + MHA(LN(x)); x + FFN(LN(x)).
@@ -178,7 +218,7 @@ class TransformerEmbed(Module):
             raise ValueError(
                 f"sequence length {t_global} exceeds max_len {self.max_len}"
             )
-        h = params["tok_embed"][tokens]
+        h = embed_lookup(params["tok_embed"], tokens)
         if self.use_pos_embed:
             positions = sharded_positions(
                 self.axis_name, t_local, self.seq_sharded, self.seq_layout
