@@ -68,6 +68,16 @@ def parse_args(argv=None) -> argparse.Namespace:
     )
     p.add_argument("--microbatches", type=int, default=4, help="pp micro-batches")
     p.add_argument(
+        "--fused_ln", action="store_true",
+        help="fused residual-add+LayerNorm junction kernels (TPU; "
+        "reference math elsewhere) — the round-4 flagship trunk",
+    )
+    p.add_argument(
+        "--fused_xent_scores", action="store_true",
+        help="fused-xent SPEED mode: keep the f32 score residual "
+        "(O(B*T*V) memory) and skip both backward recompute matmuls",
+    )
+    p.add_argument(
         "--fused_xent", action="store_true",
         help="single-device only: fused linear-cross-entropy head "
         "(Pallas) — the [B*T, V] logits are never materialized, trading "
@@ -133,6 +143,10 @@ def build_engine(args, devices):
     n = len(devices)
     if getattr(args, "fused_xent", False) and args.parallel != "single":
         raise ValueError("--fused_xent requires --parallel single")
+    if getattr(args, "fused_xent_scores", False) and not args.fused_xent:
+        # Silently no-opping would mislabel A/B numbers (the flag only
+        # configures the fused head's backward).
+        raise ValueError("--fused_xent_scores requires --fused_xent")
     base = dict(
         vocab_size=args.vocab,
         embed_dim=args.embed_dim,
@@ -145,6 +159,7 @@ def build_engine(args, devices):
         moe_experts=args.moe_experts,
         moe_top_k=args.moe_top_k,
         dropout=args.dropout,
+        fused_ln=args.fused_ln,
     )
     opt = make_optimizer("adam", args.lr)
     rng_root = jax.random.key(args.seed ^ 0xD0) if args.dropout else None
@@ -190,7 +205,10 @@ def build_engine(args, devices):
         if args.fused_xent:
             from tpudml.train import make_lm_fused_train_step
 
-            return ts, make_lm_fused_train_step(model, opt, rng_root=rng_root)
+            return ts, make_lm_fused_train_step(
+                model, opt, rng_root=rng_root,
+                save_scores=args.fused_xent_scores,
+            )
         return ts, make_train_step(model, opt, rng_root=rng_root)
     if args.parallel == "dp":
         mesh = make_mesh(MeshConfig({"data": n}), devices)

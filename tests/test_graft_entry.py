@@ -35,8 +35,9 @@ def test_entry_and_dryrun_from_clean_environment():
     """entry() must jit+run, then dryrun_multichip(8) must self-provision
     — one subprocess, driver conditions. Only a 2-regime subset runs here
     (the subprocess's job is the clean-env PROVISIONING path; compiling
-    all 16 regimes cost 98 s and duplicated both the in-process full run
-    below and the driver's own round-end dryrun)."""
+    all 16 regimes cost 98 s). Full-regime coverage lives in the
+    driver's round-end dryrun and in the per-engine pytest parity tests
+    — not in any pytest dryrun invocation."""
     proc = subprocess.run(
         [
             sys.executable,
@@ -68,8 +69,8 @@ def test_entry_and_dryrun_from_clean_environment():
 def test_dryrun_in_process_after_backend_init():
     """The latched-backend path: jax already initialized (conftest's 8-CPU
     mesh counts) must not break provisioning for n <= device_count. The
-    regimes filter keeps this to one compile — the full matrix runs in
-    the subprocess test above."""
+    regimes filter keeps this to one compile — full-regime coverage is
+    the driver's round-end dryrun + the per-engine parity tests."""
     import jax
 
     assert jax.device_count() >= 4

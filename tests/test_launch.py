@@ -239,7 +239,7 @@ def test_two_process_task5_e2e(tmp_path, parallel):
 
 @pytest.mark.slow
 def test_elastic_recovery_resumes_from_checkpoint(tmp_path):
-    """The elastic path end-to-end: rank 1 crashes mid-epoch-1 on the
+    """The elastic path end-to-end: rank 1 crashes mid-epoch-2 on the
     first attempt; the launcher relaunches (max_restarts), --resume
     restores the epoch-boundary checkpoint, and the job finishes at the
     SAME final step a crash-free run reaches (epoch-granular resume)."""

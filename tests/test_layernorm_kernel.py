@@ -17,9 +17,9 @@ from tpudml.nn.layers import LayerNorm
 from tpudml.ops.layernorm_kernel import fused_layernorm
 
 
-# (24,16,16) exercises block_n > n clamping; (10,8,8) added only
-# row padding on top of it — folded into the first case's odd n.
-@pytest.mark.parametrize("n,d,bn", [(10, 32, 8), (24, 16, 16)])
+# (16,32,8): exact grid, n % bn == 0 (no padding); (10,32,8): padded
+# last row block; (24,16,16): bn rounding against a non-multiple n.
+@pytest.mark.parametrize("n,d,bn", [(16, 32, 8), (10, 32, 8), (24, 16, 16)])
 def test_matches_reference_value_and_grads(n, d, bn):
     key = jax.random.PRNGKey(0)
     x = jax.random.normal(key, (n, d), jnp.float32) * 2 + 1
