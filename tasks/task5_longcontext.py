@@ -143,6 +143,15 @@ def build_engine(args, devices):
     n = len(devices)
     if getattr(args, "fused_xent", False) and args.parallel != "single":
         raise ValueError("--fused_xent requires --parallel single")
+    if getattr(args, "fused_ln", False) and (
+        args.parallel == "pp" or args.moe_experts
+    ):
+        # pp assembles blocks directly (no LM trunk) and MoE trunks keep
+        # the unfused path — silently no-opping would mislabel A/B runs.
+        raise ValueError(
+            "--fused_ln is not supported with --parallel pp or MoE "
+            "(--moe_experts); the flag would silently no-op"
+        )
     if getattr(args, "fused_xent_scores", False) and not args.fused_xent:
         # Silently no-opping would mislabel A/B numbers (the flag only
         # configures the fused head's backward).

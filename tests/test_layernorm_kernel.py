@@ -115,26 +115,31 @@ def test_add_ln_bf16_rounds_sum_before_stats():
     )
 
 
-def test_fused_ln_lm_matches_unfused():
+@pytest.mark.parametrize("dropout", [0.0, 0.3])
+def test_fused_ln_lm_matches_unfused(dropout):
     """TransformerLM(fused_ln=True) is numerically the same model: on a
     non-TPU backend the fused junctions dispatch to reference math, so
-    logits and grads must match the standard trunk exactly."""
+    logits and grads must match the standard trunk exactly. The dropout
+    case additionally pins that the deferred trunk folds the SAME
+    per-block keys and salts (train-mode rng threading)."""
     from tpudml.models import TransformerLM
 
     kw = dict(vocab_size=64, embed_dim=32, num_heads=2, num_layers=2,
-              max_len=16, rope=True)
+              max_len=16, rope=True, dropout=dropout)
+    train = dropout > 0
+    rng = jax.random.PRNGKey(7) if train else None
     base = TransformerLM(**kw)
     fused = TransformerLM(**kw, fused_ln=True)
     params, _ = base.init(jax.random.PRNGKey(0))
     tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 64)
 
-    lb, _ = base.apply(params, {}, tokens)
-    lf, _ = fused.apply(params, {}, tokens)
+    lb, _ = base.apply(params, {}, tokens, train=train, rng=rng)
+    lf, _ = fused.apply(params, {}, tokens, train=train, rng=rng)
     np.testing.assert_allclose(np.asarray(lb), np.asarray(lf), rtol=1e-5,
                                atol=1e-5)
 
     def loss(model, p):
-        out, _ = model.apply(p, {}, tokens)
+        out, _ = model.apply(p, {}, tokens, train=train, rng=rng)
         return jnp.mean(jnp.square(out))
 
     gb = jax.grad(lambda p: loss(base, p))(params)
@@ -148,8 +153,8 @@ def test_fused_ln_lm_matches_unfused():
         )
 
     # features path (fused-xent input contract) matches too
-    hb, _ = base.apply_features(params, {}, tokens)
-    hf, _ = fused.apply_features(params, {}, tokens)
+    hb, _ = base.apply_features(params, {}, tokens, train=train, rng=rng)
+    hf, _ = fused.apply_features(params, {}, tokens, train=train, rng=rng)
     np.testing.assert_allclose(np.asarray(hb), np.asarray(hf), rtol=1e-5,
                                atol=1e-5)
 
