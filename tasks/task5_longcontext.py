@@ -79,10 +79,12 @@ def parse_args(argv=None) -> argparse.Namespace:
     )
     p.add_argument(
         "--fused_xent", action="store_true",
-        help="single-device only: fused linear-cross-entropy head "
-        "(Pallas) — the [B*T, V] logits are never materialized, trading "
-        "~2 ms/step of score recompute for O(B*T) head residual memory "
-        "(very long T / large vocab regimes); loss-only metrics",
+        help="fused linear-cross-entropy head (Pallas) — the [B*T, V] "
+        "logits are never materialized, trading ~2 ms/step of score "
+        "recompute for O(B*T) head residual memory (very long T / large "
+        "vocab regimes); loss-only metrics. Composes with "
+        "--parallel single/dp/cp (the kernel is token-parallel); the "
+        "vocab-sharded TP head is documented in docs/API.md",
     )
     p.add_argument(
         "--target_loss", type=float, default=None,
@@ -141,16 +143,26 @@ def parse_args(argv=None) -> argparse.Namespace:
 def build_engine(args, devices):
     """(train_state, step_fn) for the selected strategy."""
     n = len(devices)
-    if getattr(args, "fused_xent", False) and args.parallel != "single":
-        raise ValueError("--fused_xent requires --parallel single")
-    if getattr(args, "fused_ln", False) and (
-        args.parallel == "pp" or args.moe_experts
+    if getattr(args, "fused_xent", False) and args.parallel not in (
+        "single", "dp", "cp"
     ):
-        # pp assembles blocks directly (no LM trunk) and MoE trunks keep
-        # the unfused path — silently no-opping would mislabel A/B runs.
+        # The kernel is token-parallel: it composes with any batch/seq
+        # sharding of the trunk (single/dp/cp), but NOT with a
+        # vocab-sharded head (tp/fsdp shard the head kernel's V dim —
+        # each shard's online softmax would see a partial vocab; see
+        # docs/API.md) nor with the pipeline epilogue (pp stages ship
+        # logits, not features).
         raise ValueError(
-            "--fused_ln is not supported with --parallel pp or MoE "
-            "(--moe_experts); the flag would silently no-op"
+            "--fused_xent supports --parallel single/dp/cp "
+            "(token-parallel head); tp/fsdp/pp shard or relocate the "
+            "head itself"
+        )
+    if getattr(args, "fused_ln", False) and args.moe_experts:
+        # MoE trunks keep the unfused path — silently no-opping would
+        # mislabel A/B runs (TransformerLM/Block raise too).
+        raise ValueError(
+            "--fused_ln is not supported with MoE (--moe_experts); the "
+            "flag would silently no-op"
         )
     if getattr(args, "fused_xent_scores", False) and not args.fused_xent:
         # Silently no-opping would mislabel A/B numbers (the flag only
@@ -204,7 +216,8 @@ def build_engine(args, devices):
             **base, impl=impl, seq_sharded=True, seq_layout=args.cp_layout
         )
         engine = ContextParallel(
-            model, opt, mesh, rng_root=rng_root, layout=args.cp_layout
+            model, opt, mesh, rng_root=rng_root, layout=args.cp_layout,
+            fused_xent=args.fused_xent, save_scores=args.fused_xent_scores,
         )
         return engine.create_state(seed_key(args.seed)), engine.make_train_step()
     impl = args.attn or "full"
@@ -223,7 +236,8 @@ def build_engine(args, devices):
         mesh = make_mesh(MeshConfig({"data": n}), devices)
         # [B, T] token batches are never the stacked-loader form.
         engine = DataParallel(
-            model, opt, mesh, rng_root=rng_root, stacked_batches=False
+            model, opt, mesh, rng_root=rng_root, stacked_batches=False,
+            fused_xent=args.fused_xent, save_scores=args.fused_xent_scores,
         )
         return engine.create_state(seed_key(args.seed)), engine.make_train_step()
     if args.parallel == "fsdp":
@@ -272,7 +286,7 @@ def build_engine(args, devices):
         block = TransformerBlock(
             args.embed_dim, args.num_heads, causal=True, impl=impl,
             num_kv_heads=args.num_kv_heads, rope=args.rope,
-            dropout=args.dropout,
+            dropout=args.dropout, fused_ln=args.fused_ln,
         )
         if args.schedule == "interleaved":
             from tpudml.parallel.pp import Interleaved1F1B

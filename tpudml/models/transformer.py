@@ -28,6 +28,16 @@ from tpudml.nn.attention import MultiHeadAttention, sharded_positions
 from tpudml.nn.layers import Dense, LayerNorm, Module
 
 
+# Bound on the one-hot transient the matmul backward materializes
+# (elements of [N, V] in dy.dtype). 64M elements is ~128 MB bf16 /
+# ~256 MB f32 — comfortably resident; past it the backward chunks the
+# token axis so memory stays O(cap + V·d) instead of O(N·V) (at the
+# 131k-token × 32k-vocab long-context regime the unchunked buffer would
+# be ~8.6 GB — exactly the O(N·V) blow-up the fused-xent head exists to
+# avoid).
+_ONEHOT_ELEM_CAP = 64 * 1024 * 1024
+
+
 @jax.custom_vjp
 def embed_lookup(table: jax.Array, tokens: jax.Array) -> jax.Array:
     """Token-embedding gather with a matmul backward.
@@ -39,7 +49,9 @@ def embed_lookup(table: jax.Array, tokens: jax.Array) -> jax.Array:
     (tools/micro_lm.py embed) — TPU scatter serializes per-index updates
     while the matmul is dense MXU work. Same math (each table row sums
     the cotangents of its occurrences); f32 accumulation, cast to the
-    table dtype."""
+    table dtype. Above ``_ONEHOT_ELEM_CAP`` one-hot elements the token
+    axis is chunked under ``lax.scan`` so the transient stays bounded at
+    any sequence length."""
     return table[tokens]
 
 
@@ -53,12 +65,39 @@ def _embed_lookup_bwd(res, dy):
     import numpy as np
 
     tokens, table = res
-    oh = jax.nn.one_hot(tokens.reshape(-1), table.shape[0], dtype=dy.dtype)
+    v = table.shape[0]
     d = dy.shape[-1]
-    dtable = lax.dot_general(
-        oh, dy.reshape(-1, d), (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
+    toks = tokens.reshape(-1)
+    dyf = dy.reshape(-1, d)
+    n = toks.shape[0]
+    if n * v <= _ONEHOT_ELEM_CAP:
+        oh = jax.nn.one_hot(toks, v, dtype=dy.dtype)
+        dtable = lax.dot_general(
+            oh, dyf, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+    else:
+        # Chunk the token axis: each scan step materializes one
+        # [chunk, V] one-hot tile and accumulates its matmul into the
+        # f32 dTable. Padded rows carry dy = 0, so their (token 0)
+        # one-hot contributes nothing.
+        chunk = max(_ONEHOT_ELEM_CAP // v, 8)
+        pad = (-n) % chunk
+        if pad:
+            toks = jnp.pad(toks, (0, pad))
+            dyf = jnp.pad(dyf, ((0, pad), (0, 0)))
+        toks_c = toks.reshape(-1, chunk)
+        dy_c = dyf.reshape(-1, chunk, d)
+
+        def body(acc, args):
+            t, g = args
+            oh = jax.nn.one_hot(t, v, dtype=g.dtype)
+            return acc + lax.dot_general(
+                oh, g, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ), None
+
+        dtable, _ = lax.scan(body, jnp.zeros((v, d), jnp.float32), (toks_c, dy_c))
     return (
         dtable.astype(table.dtype),
         np.zeros(tokens.shape, dtype=jax.dtypes.float0),
@@ -94,7 +133,24 @@ class TransformerBlock(Module):
     moe_axis: str | None = None
     moe_capacity_factor: float = 2.0
     moe_top_k: int = 1
+    # Fuse the block's ln2 junction (x + attn_out → LayerNorm) into one
+    # add+LN Pallas kernel per direction. This is the PIPELINE-stage form
+    # of the LM's deferred trunk: the block keeps its shape-preserving
+    # x → x contract (the closing residual add stays unfused, so the
+    # stage payload is still one tensor), fusing 1 of its 2 junctions —
+    # the LM's ``fused_ln`` trunk fuses 2L of 2L+1 by deferring adds
+    # across block boundaries, which a pipeline cut cannot do. Dense FFN
+    # only (construction raises with MoE).
+    fused_ln: bool = False
     dtype: Any = jnp.float32
+
+    def __post_init__(self):
+        if self.fused_ln and self.moe_experts:
+            raise ValueError(
+                "fused_ln=True is not supported with moe_experts (the MoE "
+                "trunk keeps the unfused junctions); a silent no-op would "
+                "mislabel A/B comparisons"
+            )
 
     def _parts(self):
         d = self.embed_dim
@@ -162,6 +218,18 @@ class TransformerBlock(Module):
         new_state = {}
         h = parts["ln1"](params["ln1"], x)
         h = parts["attn"](params["attn"], h)
+        if self.fused_ln:
+            from tpudml.ops.layernorm_kernel import fused_add_layernorm
+
+            s, y2 = fused_add_layernorm(
+                x,
+                self._drop(h, train, rng, 1),
+                params["ln2"]["scale"],
+                params["ln2"]["bias"],
+            )
+            h = jax.nn.gelu(parts["fc1"](params["fc1"], y2))
+            h = parts["fc2"](params["fc2"], h)
+            return s + self._drop(h, train, rng, 2), new_state
         x = x + self._drop(h, train, rng, 1)
         h = parts["ln2"](params["ln2"], x)
         if self.moe_experts:
@@ -299,6 +367,16 @@ class TransformerLM(Module):
     # jnp.float32: the legacy all-bf16 mode (dtype=bf16, compute_dtype
     # unset) must keep computing in bf16, not get upcast.
     compute_dtype: Any = None
+
+    def __post_init__(self):
+        if self.fused_ln and self.moe_experts:
+            # Mirror the task5 CLI guard for direct API users: silently
+            # falling back to the unfused trunk would mislabel A/B
+            # comparisons (the exact failure mode the guard exists for).
+            raise ValueError(
+                "fused_ln=True is not supported with moe_experts (MoE "
+                "trunks keep the unfused junctions); drop one of the two"
+            )
 
     def _block(self) -> TransformerBlock:
         return TransformerBlock(

@@ -253,6 +253,24 @@ def _scale_cotangents(dx, dw, db, g, x, w, b):
     )
 
 
+def _pick_bv_dw(v_pad: int, block_v: int, bv_cap: int) -> int:
+    """dW vocab tile: ``block_v`` when it already meets the VMEM cap,
+    else the largest 128-multiple divisor of ``v_pad`` under the cap —
+    repeated halving could strand a non-power-of-two ``block_v`` (e.g.
+    384) above it. When ``block_v`` exceeds the cap it is ≥ 256 and a
+    multiple of 128 (small vocabs clamp block_v to v_pad ≤ cap), so 128
+    always divides ``v_pad`` and the search cannot come up empty; the
+    ``block_v`` fallback keeps the pre-search behavior (tile above cap)
+    for any exotic hand-picked block size."""
+    cap = max(128, bv_cap)
+    if block_v <= cap:
+        return block_v
+    for cand in range(cap - cap % 128, 127, -128):
+        if v_pad % cand == 0:
+            return cand
+    return block_v
+
+
 def _fused_backward_saved(x, w, b, labels, lse, s, g, block_n, block_v,
                           interpret):
     (n, d, v, block_n, block_v, n_pad, v_pad, xf, wf, lf, lsef
@@ -274,14 +292,12 @@ def _fused_backward_saved(x, w, b, labels, lse, s, g, block_n, block_v,
     )(s, wf, lf, lsef)[:n]
     # dW tile cap: the f32 s tiles + f32 accumulator must fit scoped VMEM
     # (~16 MB): 4·d·bv (acc) + 8·bn·bv (s ×2 buffers) + 8·d·bv (dw out
-    # ×2, f32 worst case) ≤ ~12 MB. Halve bv (staying a multiple of 128,
-    # so it still divides v_pad) until it fits.
+    # ×2, f32 worst case) ≤ ~12 MB. Pick the largest 128-multiple divisor
+    # of v_pad under the cap (_pick_bv_dw) — 128 always qualifies.
     bv_cap = max(
         128, (12 * 1024 * 1024) // (12 * d + 8 * block_n) // 128 * 128
     )
-    bv_dw = block_v
-    while bv_dw > bv_cap and bv_dw % 2 == 0 and (bv_dw // 2) % 128 == 0:
-        bv_dw //= 2
+    bv_dw = _pick_bv_dw(v_pad, block_v, bv_cap)
     dw, db = pl.pallas_call(
         partial(_dw_s_kernel, block_v=bv_dw, v_valid=v, inv_n=1.0 / n),
         out_shape=[

@@ -392,9 +392,13 @@ class ContextParallel:
         rng_root: jax.Array | None = None,
         aux_loss_weight: float | None = None,
         layout: str = "contiguous",
+        fused_xent: bool = False,
+        save_scores: bool = False,
     ):
         if layout not in ("contiguous", "striped"):
             raise ValueError(f"unknown layout {layout!r}")
+        if save_scores and not fused_xent:
+            raise ValueError("save_scores requires fused_xent=True")
         model_layout = getattr(model, "seq_layout", "contiguous")
         if model_layout != layout:
             raise ValueError(
@@ -410,6 +414,17 @@ class ContextParallel:
         self.rng_root = rng_root  # per-step/per-shard dropout streams
         # Dense-MoE runs get the Switch load-balancing pressure by default
         # (None → α=0.01 when the model contains MoE layers).
+        # fused_xent: the head runs through the fused linear-cross-entropy
+        # kernel instead of materializing logits — token-parallel, so the
+        # same per-shard-mean → pmean structure holds under the seq
+        # sharding; metrics carry loss only (no logits ⇒ no accuracy).
+        self.fused_xent = fused_xent
+        if fused_xent:
+            from tpudml.train import make_lm_fused_loss_fn
+
+            self._fused_loss_fn = make_lm_fused_loss_fn(
+                model, save_scores, aux_loss_weight
+            )
         self._loss_fn = make_loss_fn(
             model, softmax_cross_entropy,
             resolve_aux_loss_weight(model, aux_loss_weight),
@@ -501,9 +516,16 @@ class ContextParallel:
                     lax.axis_index(axis),
                 )
 
-            (loss, (model_state, logits)), grads = jax.value_and_grad(
-                self._loss_fn, has_aux=True
-            )(ts.params, ts.model_state, tokens, labels, rng)
+            if self.fused_xent:
+                (loss, model_state), grads = jax.value_and_grad(
+                    self._fused_loss_fn, has_aux=True
+                )(ts.params, ts.model_state, tokens, labels, rng)
+                metrics = {}
+            else:
+                (loss, (model_state, logits)), grads = jax.value_and_grad(
+                    self._loss_fn, has_aux=True
+                )(ts.params, ts.model_state, tokens, labels, rng)
+                metrics = {"accuracy": accuracy(logits, labels)}
             axes = self._mean_axes()
             grads = pmean_tree(grads, axes)
             # Shard-consistent model state (e.g. norm running stats), same
@@ -512,7 +534,7 @@ class ContextParallel:
             new_params, new_opt = self.optimizer.update(grads, ts.opt_state, ts.params)
             metrics = {
                 "loss": lax.pmean(loss, axes),
-                "accuracy": lax.pmean(accuracy(logits, labels), axes),
+                **{k: lax.pmean(v, axes) for k, v in metrics.items()},
             }
             new_ts = TrainState(
                 params=new_params,

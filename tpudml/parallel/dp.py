@@ -77,7 +77,25 @@ class DataParallel:
         loss: Callable = softmax_cross_entropy,
         stacked_batches: bool | None = None,
         aux_loss_weight: float | None = None,
+        fused_xent: bool = False,
+        save_scores: bool = False,
     ):
+        if save_scores and not fused_xent:
+            raise ValueError("save_scores requires fused_xent=True")
+        if fused_xent and (
+            measure_comm or accum_steps != 1
+            or loss is not softmax_cross_entropy
+        ):
+            # The fused head IS the loss fn (linear cross-entropy); the
+            # split-step timing path, scan-accumulation, and custom
+            # ``loss`` callables all wrap the LOGITS loss fn — wire them
+            # up when a use case appears rather than silently ignoring
+            # the arguments.
+            raise ValueError(
+                "fused_xent composes with the fused step and the "
+                "built-in cross-entropy only (measure_comm=False, "
+                "accum_steps=1, default loss)"
+            )
         self.model = model
         self.optimizer = optimizer
         self.mesh = mesh
@@ -97,6 +115,16 @@ class DataParallel:
         self.world = mesh.shape[axis_name]
         # Dense-MoE runs get the Switch load-balancing pressure by default
         # (None → α=0.01 when the model contains MoE layers).
+        # fused_xent: the LM head runs through the fused linear-cross-
+        # entropy kernel (token-parallel, so a batch-sharded trunk needs
+        # no resharding); metrics carry loss only.
+        self.fused_xent = fused_xent
+        if fused_xent:
+            from tpudml.train import make_lm_fused_loss_fn
+
+            self._fused_loss_fn = make_lm_fused_loss_fn(
+                model, save_scores, aux_loss_weight
+            )
         self._loss_fn = make_loss_fn(
             model, loss, resolve_aux_loss_weight(model, aux_loss_weight)
         )
@@ -186,10 +214,16 @@ class DataParallel:
                 jax.random.fold_in(self.rng_root, ts.step),
                 jax.lax.axis_index(self.axis_name),
             )
-        grads, model_state, local = accumulate_grads(
-            self._loss_fn, ts.params, ts.model_state, images, labels, rng,
-            self.accum_steps,
-        )
+        if self.fused_xent:
+            (loss, model_state), grads = jax.value_and_grad(
+                self._fused_loss_fn, has_aux=True
+            )(ts.params, ts.model_state, images, labels, rng)
+            local = {"loss": loss}
+        else:
+            grads, model_state, local = accumulate_grads(
+                self._loss_fn, ts.params, ts.model_state, images, labels, rng,
+                self.accum_steps,
+            )
         grads = self.aggregator(grads, self.axis_name)
         # Cross-replica-consistent BN stats: average the running stats so
         # every replica holds the same model_state (the reference's DDP
@@ -198,8 +232,7 @@ class DataParallel:
         model_state = pmean_tree(model_state, self.axis_name)
         new_params, new_opt = self.optimizer.update(grads, ts.opt_state, ts.params)
         metrics = {
-            "loss": jax.lax.pmean(local["loss"], self.axis_name),
-            "accuracy": jax.lax.pmean(local["accuracy"], self.axis_name),
+            k: jax.lax.pmean(v, self.axis_name) for k, v in local.items()
         }
         new_ts = TrainState(
             params=new_params,

@@ -193,21 +193,25 @@ def make_train_step_body(
     return step
 
 
-def make_lm_fused_train_step_body(
+def make_lm_fused_loss_fn(
     model: Module,
-    optimizer: Optimizer,
-    rng_root: jax.Array | None = None,
     save_scores: bool = False,
+    aux_loss_weight: float | None = None,
 ) -> Callable:
-    """Un-jitted (ts, tokens, labels) -> (new_ts, metrics) body of
-    :func:`make_lm_fused_train_step` — composable under ``lax.fori_loop``
-    (bench.py times K of these inside one dispatch, like
-    :func:`make_train_step_body` for the standard step)."""
+    """(params, model_state, tokens, labels[, rng]) -> (loss, new_state)
+    through the fused linear-cross-entropy head: ``apply_features`` +
+    ``linear_cross_entropy`` — the [B·T, V] logits never exist. The model
+    must expose ``apply_features`` and a ``head`` Dense param subtree.
+    The kernel is token-parallel, so this loss fn composes under
+    ``shard_map`` on a batch/sequence-sharded trunk unchanged (the DP/CP
+    engines' ``fused_xent`` mode): each shard's token-mean loss pmean-s
+    to the global token mean for equal-size shards, exactly like the
+    standard loss path."""
     from tpudml.ops.xent_kernel import linear_cross_entropy
 
-    aux_w = resolve_aux_loss_weight(model, None)
+    aux_w = resolve_aux_loss_weight(model, aux_loss_weight)
 
-    def loss_fn(params, model_state, tokens, labels, rng):
+    def loss_fn(params, model_state, tokens, labels, rng=None):
         feats, new_state = model.apply_features(
             params, model_state, tokens, train=True, rng=rng
         )
@@ -219,6 +223,21 @@ def make_lm_fused_train_step_body(
         if aux_w:
             loss = loss + aux_w * collect_aux_losses(new_state)
         return loss, new_state
+
+    return loss_fn
+
+
+def make_lm_fused_train_step_body(
+    model: Module,
+    optimizer: Optimizer,
+    rng_root: jax.Array | None = None,
+    save_scores: bool = False,
+) -> Callable:
+    """Un-jitted (ts, tokens, labels) -> (new_ts, metrics) body of
+    :func:`make_lm_fused_train_step` — composable under ``lax.fori_loop``
+    (bench.py times K of these inside one dispatch, like
+    :func:`make_train_step_body` for the standard step)."""
+    loss_fn = make_lm_fused_loss_fn(model, save_scores)
 
     def step(ts: TrainState, tokens, labels):
         rng = None if rng_root is None else jax.random.fold_in(rng_root, ts.step)
