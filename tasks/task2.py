@@ -120,6 +120,9 @@ def run(cfg: TrainConfig) -> dict:
     writer.close()
     metrics["test_accuracy"] = acc
     metrics["world"] = world
+    # Exact artifact location for tooling (tools/plot_runs.py --regen):
+    # guessing the run dir by newest-mtime races with concurrent writers.
+    metrics["run_dir"] = str(writer.run_dir)
     return metrics
 
 
