@@ -93,12 +93,14 @@ def _make_pipe_stages(n_stages, n_mb=4, v_chunks=2, opt=None):
     )
 
 
-def test_update_matches_single_device_odd_stages(batch):
-    """S=3 exercises the classic two-ppermute tick (phases interleave per
-    chunk parity on odd S, so the combined even-S ppermute doesn't apply)."""
+@pytest.mark.parametrize("n_stages,v", [(3, 2), (3, 3), (5, 2)])
+def test_update_matches_single_device_odd_stages(batch, n_stages, v):
+    """Odd S exercises the parity-class half-buffer ring ticks (fwd ships
+    chunks v ≡ t+s, bwd the complement; v=3 additionally exercises the
+    ragged-parity pad slot, and S=5 a longer odd ring's wrap edge)."""
     x, y = batch
     opt = make_optimizer("sgd", 0.05, momentum=0.9)
-    pipe = _make_pipe_stages(3, opt=opt)
+    pipe = _make_pipe_stages(n_stages, v_chunks=v, opt=opt)
     ts = pipe.create_state(seed_key(1))
     params0 = jax.device_get(ts.params)
 
@@ -155,12 +157,14 @@ def _step_ppermute_bytes(pipe, x, y):
     return _ppermute_bytes(jaxpr.jaxpr)
 
 
-def test_even_s_combined_ppermute_halves_ring_bytes(batch):
-    """VERDICT r3 item 5's accounting: the even-S combined ppermute ships
-    HALF the per-tick ring bytes of the classic two-buffer tick (the odd-S
-    path) — 1×[V, act] vs 2×[V, act] per tick. (A [<V] buffer is not
-    possible: on a live tick every in-window chunk of a device fires,
-    see the class docstring's ring-traffic note.)"""
+def test_ring_bytes_at_the_combined_floor_for_even_and_odd_s(batch):
+    """VERDICT r3 item 5 + r4 item 7's accounting: BOTH parities of S ship
+    V act-slots per tick (for even V) — even S as ONE combined [V, act]
+    ppermute, odd S as TWO [V/2, act] parity-class ppermutes (fwd lives
+    on chunks v ≡ t+s, bwd on the complement; see the class docstring's
+    ring-traffic note). The classic two-full-buffer tick would be
+    2·V·act. (A [<V] combined buffer is not possible: on a live tick
+    every in-window chunk of a device fires.)"""
     x, y = batch
     M, V = 4, 2
     even = _make_pipe_stages(4, n_mb=M, v_chunks=V)
@@ -173,8 +177,19 @@ def test_even_s_combined_ppermute_halves_ring_bytes(batch):
     per_tick_odd = bytes_odd / ticks_odd
     act_bytes = BATCH // M * WIDTH * 4  # f32 micro activation
     assert per_tick_even == V * act_bytes  # ONE [V, act] buffer per tick
-    assert per_tick_odd == 2 * V * act_bytes  # the classic pair
-    assert per_tick_even * 2 == per_tick_odd
+    assert per_tick_odd == V * act_bytes   # TWO [V/2, act] parity halves
+
+
+def test_odd_s_odd_v_ring_bytes_pad_one_slot(batch):
+    """V odd on odd S: the parity classes are ragged (⌈V/2⌉ vs ⌊V/2⌋), so
+    the static half-buffer pads one slot — 2·⌈V/2⌉ per tick, still under
+    the classic 2·V whenever V > 1."""
+    x, y = batch
+    M, V = 4, 3
+    odd = _make_pipe_stages(3, n_mb=M, v_chunks=V)
+    per_tick = _step_ppermute_bytes(odd, x, y) / (2 * (M + V * 3 - 1))
+    act_bytes = BATCH // M * WIDTH * 4
+    assert per_tick == 2 * ((V + 1) // 2) * act_bytes  # 4 < 2·V = 6
 
 
 def test_training_descends_with_dropout(batch):

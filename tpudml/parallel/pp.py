@@ -923,9 +923,16 @@ class Interleaved1F1B(GPipe):
       floor: on live ticks ALL in-window chunks of a device fire
       together (the windows overlap whenever 2M > S), so the live slot
       count on a firing device is V, not 1-2, and no static [<V] buffer
-      can carry it. Odd S interleaves the phases per chunk parity
-      instead, so it keeps the classic two-ppermute tick. Accounted by
-      the transfer-bytes test (jaxpr ppermute operand totals).
+      can carry it. Odd S (round 5) reaches the same BYTE floor a
+      different way: its phases are complementary per CHUNK PARITY
+      (fwd lives on v ≡ t+s, bwd on the complement — σ = vS + s has
+      parity v + s when S is odd), so each direction ships only its
+      [⌈V/2⌉] parity class, reconstructed at the receiver with the
+      actual sender's parity (the wrap edge of an odd ring flips it).
+      2·⌈V/2⌉ slots per tick vs even-S's V; the residual odd-S cost is
+      message COUNT (2 ppermutes — opposite directions cannot share a
+      permutation). Accounted by the transfer-bytes test (jaxpr
+      ppermute operand totals).
     - dropout: per-(virtual stage, micro) keys, refolded identically in
       the backward recompute — grads stay exact for the dropout-applied
       function (the OneFOneB contract).
@@ -1223,12 +1230,48 @@ class Interleaved1F1B(GPipe):
                 pair_body, init, jnp.arange(n_ticks // 2)
             )
         else:
+            # Odd S (round 5): the phases are not complementary per DEVICE
+            # (σ = vS + s parity is v + s when S is odd), but they ARE
+            # complementary per CHUNK PARITY — on tick t, device s's fwd
+            # units live exactly on chunks v ≡ t + s (mod 2) and its bwd
+            # units on the complement. So each direction only needs its
+            # parity class: pack the live half of each [V, ...] buffer
+            # into a [⌈V/2⌉, ...] buffer and ppermute that — 2·⌈V/2⌉
+            # act-slots per tick, the same byte floor as the even-S
+            # combined buffer (2 messages instead of 1 is the remaining
+            # odd-S cost: the two directions have different destinations,
+            # so they cannot share one permutation).
+            #
+            # Wrap subtlety: around an odd ring, sender parity t + s is
+            # NOT consistent across the S-1 → 0 edge ((s − 1) mod S flips
+            # parity there), so the receiver reconstructs physical slot
+            # ids with the ACTUAL sender's parity — (t + (s−1) mod S) for
+            # fwd, (t + (s+1) mod S + 1) for bwd — and scatters the half
+            # buffer back into a zeros [V, ...] at those slots. Receivers
+            # only ever read slots their own valid units consume, which
+            # are exactly the reconstructed ones (docstring invariants),
+            # so the zero filler is never observed. V odd pads the last
+            # slot (index V clips on pack, drops on scatter).
+            Vh = (V + 1) // 2
+            lane = jnp.arange(Vh)
+
+            def pack(buf, parity):
+                idx = jnp.minimum(parity + 2 * lane, V - 1)
+                return jnp.take(buf, idx, axis=0)
+
+            def unpack(half, parity):
+                full = jnp.zeros((V,) + half.shape[1:], half.dtype)
+                return full.at[parity + 2 * lane].set(half, mode="drop")
+
             def tick(carry, t):
                 carry, fs, bs = tick_core(carry, t)
+                pf = (t + stage) % 2          # fwd-live chunk parity here
+                fs_h = ppermute_ring(pack(fs, pf), axis, 1)
+                bs_h = ppermute_ring(pack(bs, 1 - pf), axis, -1)
+                pf_r = (t + (stage - 1) % S) % 2      # fwd sender's parity
+                pb_r = (t + (stage + 1) % S + 1) % 2  # bwd sender's parity
                 return set_recv(
-                    carry,
-                    ppermute_ring(fs, axis, 1),
-                    ppermute_ring(bs, axis, -1),
+                    carry, unpack(fs_h, pf_r), unpack(bs_h, pb_r)
                 ), None
 
             (_, _, _, g_ch, g_pro, g_epi, loss_sum, acc_sum), _ = lax.scan(
