@@ -74,8 +74,15 @@ def parse_args(argv=None) -> argparse.Namespace:
     )
     p.add_argument(
         "--fused_xent_scores", action="store_true",
-        help="fused-xent SPEED mode: keep the f32 score residual "
-        "(O(B*T*V) memory) and skip both backward recompute matmuls",
+        help="fused-xent SPEED mode: FORCE the f32 score residual "
+        "(O(B*T*V) memory, 2 fewer backward matmuls); default is AUTO — "
+        "speed mode while the residual fits the 2 GiB budget, the O(B*T) "
+        "lean mode beyond (xent_kernel.SAVE_S_AUTO_MAX_BYTES)",
+    )
+    p.add_argument(
+        "--fused_xent_lean", action="store_true",
+        help="FORCE the fused-xent O(B*T) lean backward (recompute "
+        "matmuls) regardless of the auto threshold",
     )
     p.add_argument(
         "--fused_xent", action="store_true",
@@ -164,10 +171,20 @@ def build_engine(args, devices):
             "--fused_ln is not supported with MoE (--moe_experts); the "
             "flag would silently no-op"
         )
-    if getattr(args, "fused_xent_scores", False) and not args.fused_xent:
-        # Silently no-opping would mislabel A/B numbers (the flag only
-        # configures the fused head's backward).
-        raise ValueError("--fused_xent_scores requires --fused_xent")
+    scores = getattr(args, "fused_xent_scores", False)
+    lean = getattr(args, "fused_xent_lean", False)
+    if (scores or lean) and not args.fused_xent:
+        # Silently no-opping would mislabel A/B numbers (the flags only
+        # configure the fused head's backward).
+        raise ValueError(
+            "--fused_xent_scores/--fused_xent_lean require --fused_xent"
+        )
+    if scores and lean:
+        raise ValueError(
+            "--fused_xent_scores and --fused_xent_lean are exclusive"
+        )
+    # Tristate: force-on / force-lean / None = auto by residual size.
+    args._save_scores = True if scores else (False if lean else None)
     base = dict(
         vocab_size=args.vocab,
         embed_dim=args.embed_dim,
@@ -217,7 +234,7 @@ def build_engine(args, devices):
         )
         engine = ContextParallel(
             model, opt, mesh, rng_root=rng_root, layout=args.cp_layout,
-            fused_xent=args.fused_xent, save_scores=args.fused_xent_scores,
+            fused_xent=args.fused_xent, save_scores=args._save_scores,
         )
         return engine.create_state(seed_key(args.seed)), engine.make_train_step()
     impl = args.attn or "full"
@@ -229,7 +246,7 @@ def build_engine(args, devices):
 
             return ts, make_lm_fused_train_step(
                 model, opt, rng_root=rng_root,
-                save_scores=args.fused_xent_scores,
+                save_scores=args._save_scores,
             )
         return ts, make_train_step(model, opt, rng_root=rng_root)
     if args.parallel == "dp":
@@ -237,7 +254,7 @@ def build_engine(args, devices):
         # [B, T] token batches are never the stacked-loader form.
         engine = DataParallel(
             model, opt, mesh, rng_root=rng_root, stacked_batches=False,
-            fused_xent=args.fused_xent, save_scores=args.fused_xent_scores,
+            fused_xent=args.fused_xent, save_scores=args._save_scores,
         )
         return engine.create_state(seed_key(args.seed)), engine.make_train_step()
     if args.parallel == "fsdp":

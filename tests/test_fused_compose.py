@@ -282,6 +282,23 @@ def test_embed_backward_chunked_matches_dense(monkeypatch):
     np.testing.assert_allclose(np.asarray(chunked), np.asarray(reference), rtol=1e-5, atol=1e-6)
 
 
+def test_save_s_auto_threshold():
+    """save_s=None resolves to speed mode iff the padded f32 score
+    residual fits SAVE_S_AUTO_MAX_BYTES (VERDICT r4 item 5's default-on
+    criterion): flagship 8k×32k (1 GiB) and chip-filling 16k×32k (2 GiB)
+    are ON; the 131k-token long-context regime (16 GiB) falls back to
+    the O(N) lean contract."""
+    from tpudml.ops.xent_kernel import _auto_save_s
+
+    bn, bv = 256, 2048
+    assert _auto_save_s(8192, 32768, bn, bv) is True     # flagship
+    assert _auto_save_s(16384, 32768, bn, bv) is True    # --large (2 GiB)
+    assert _auto_save_s(16640, 32768, bn, bv) is False   # just past budget
+    assert _auto_save_s(131072, 32768, bn, bv) is False  # long-context
+    # Padding counts: n=1 still pads to a block row multiple of 8.
+    assert _auto_save_s(1, 256, bn, bv) is True
+
+
 def test_pick_bv_dw_divisor_contract():
     from tpudml.ops.xent_kernel import _pick_bv_dw
 

@@ -1,0 +1,124 @@
+"""Single-chip A/B recordings for the round-4/5 fused kernels.
+
+Two recordings (both on one real chip, flagship config — 6L·512d·4H(dh128),
+T=1024, B=8, V=32k, bf16, flash attention):
+
+1. ``head``: in-situ 3-way head comparison on the SINGLE-device step —
+   standard materialized-logits step vs fused-xent lean vs fused-xent
+   save-s, fori median-of-3 each. The kernel-granularity microbench
+   (tools/xent_micro.py) cannot separate these within jitter; the
+   whole-step numbers are where the save-s default earns (or loses)
+   its place.
+2. ``cp``: the ContextParallel engine on a 1-device {"seq": 1} mesh,
+   with and without the fused kernels (fused_ln trunk + fused_xent
+   head) — VERDICT r4 item 1's done-criterion: the multi-chip engine's
+   per-chip step must profit from the kernels exactly like the
+   single-device step. World=1 makes the ring degenerate (no
+   communication), so the delta is pure kernel effect at matched
+   engine overhead. Protocol: pipelined (chained donated dispatches,
+   sync at end) — the engine step is pre-jitted with donation, so the
+   fori body cannot wrap it; both sides share the protocol, making the
+   A/B valid, and today's pipelined runs sit within ~8% of fori.
+"""
+
+from __future__ import annotations
+
+import os
+import statistics
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from bench import _time_fori, _time_pipelined  # noqa: E402
+
+from tpudml.core.config import MeshConfig
+from tpudml.core.dist import make_mesh
+from tpudml.core.prng import seed_key
+from tpudml.data.datasets import synthetic_lm
+from tpudml.models import TransformerLM
+from tpudml.optim import make_optimizer
+from tpudml.parallel.cp import ContextParallel
+from tpudml.train import (
+    TrainState,
+    make_lm_fused_train_step_body,
+    make_train_step_body,
+)
+
+CFG = dict(
+    vocab_size=32768, embed_dim=512, num_heads=4, num_layers=6,
+    max_len=1024, rope=True, compute_dtype=jnp.bfloat16,
+)
+T, B = 1024, 8
+
+
+def _batch():
+    seqs = jnp.asarray(synthetic_lm(B, T, CFG["vocab_size"], seed=1))
+    return seqs[:, :-1], seqs[:, 1:]
+
+
+def run_head():
+    print("== in-situ head A/B (single-device step, fori median-of-3)")
+    x, y = _batch()
+    opt = make_optimizer("adamw", 3e-4)
+    model = TransformerLM(**CFG, impl="flash", fused_ln=True)
+
+    def variants():
+        std = make_train_step_body(model, opt)
+        yield "standard (materialized logits)", lambda ts, xx, yy: (
+            lambda r: (r[0], r[1]["loss"])
+        )(std(ts, xx, yy))
+        for label, ss in [("fused lean", False), ("fused save-s", True)]:
+            fb = make_lm_fused_train_step_body(model, opt, save_scores=ss)
+            yield label, lambda ts, xx, yy, fb=fb: (
+                lambda r: (r[0], r[1]["loss"])
+            )(fb(ts, xx, yy))
+
+    for label, body in variants():
+        ts0 = TrainState.create(model, opt, seed_key(0))
+        sec, runs = _time_fori(body, ts0, (x, y), 8, 40, reps=3)
+        print(
+            f"   {label:34s} {sec*1e3:7.2f} ms/step  "
+            f"runs {[round(r*1e3, 2) for r in sorted(runs)]}",
+            flush=True,
+        )
+
+
+def run_cp():
+    print("== CP engine (1-device seq mesh) with/without fused kernels")
+    print("   protocol: pipelined, 30 iters, median of 3 passes")
+    x, y = _batch()
+    mesh = make_mesh(MeshConfig({"seq": 1}), jax.devices()[:1])
+    opt = make_optimizer("adamw", 3e-4)
+    for label, fused in [("unfused trunk + logits head", False),
+                         ("fused_ln + fused_xent", True)]:
+        model = TransformerLM(
+            **CFG, impl="ring", seq_sharded=True, fused_ln=fused
+        )
+        eng = ContextParallel(model, opt, mesh, fused_xent=fused)
+        step = eng.make_train_step()
+        secs = []
+        for _ in range(3):
+            ts = eng.create_state(seed_key(0))
+            secs.append(_time_pipelined(step, ts, (x, y), 30))
+        sec = statistics.median(secs)
+        print(
+            f"   {label:34s} {sec*1e3:7.2f} ms/step  "
+            f"({B*T/sec:,.0f} tok/s)  runs "
+            f"{[round(s*1e3, 2) for s in sorted(secs)]}",
+            flush=True,
+        )
+
+
+def main():
+    which = set(sys.argv[1:]) or {"head", "cp"}
+    if "head" in which:
+        run_head()
+    if "cp" in which:
+        run_cp()
+
+
+if __name__ == "__main__":
+    main()

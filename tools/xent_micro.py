@@ -1,0 +1,121 @@
+"""Kernel-granularity fused-xent microbench (VERDICT r4 item 5).
+
+Times the three head implementations at the flagship shape
+([8192, 512] × [512, 32768], bf16 weights) with the fori differencing
+discipline at KERNEL granularity, repeated enough to separate the
+save-s mode from XLA run-to-run jitter (the round-4 recording read
+"3.7-5.7 across runs" and could not call a winner):
+
+- ``xla``: the memory-lean XLA reference (materialized logits,
+  lean-VJP softmax_cross_entropy) — value_and_grad wrt (x, W).
+- ``lean``: the Pallas fused kernel, O(N) residuals, recompute backward.
+- ``saves``: the Pallas fused kernel with the f32 score residual
+  (O(N·V) memory, 2 fewer backward matmuls).
+
+Each variant is timed ``--reps`` times (median + spread printed); the
+decision rule for the save-s default is printed at the end.
+"""
+
+from __future__ import annotations
+
+import os
+import statistics
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from bench import _fetch  # noqa: E402
+
+from tpudml.nn.losses import softmax_cross_entropy
+from tpudml.ops.xent_kernel import linear_cross_entropy
+
+
+def time_grad(fn, x, w, y, reps, k_lo=8, k_hi=24):
+    """Median-of-reps fori-differenced sec/call of value_and_grad(fn)."""
+    vg = jax.value_and_grad(lambda x, w: fn(x, w, y), argnums=(0, 1))
+
+    @jax.jit
+    def run(x, w, k):
+        def one(_, carry):
+            s, x, w = carry
+            eps = (s * 1e-30).astype(x.dtype)
+            loss, (dx, dw) = vg(x + eps, w + eps.astype(w.dtype))
+            s = loss + jnp.sum(dx).astype(jnp.float32) * 1e-30 + jnp.sum(
+                dw
+            ).astype(jnp.float32) * 1e-30
+            return s.astype(jnp.float32), x, w
+
+        return jax.lax.fori_loop(0, k, one, (jnp.zeros((), jnp.float32), x, w))
+
+    def timed(k):
+        t0 = time.perf_counter()
+        s, _, _ = run(x, w, k)
+        _fetch(s)
+        return time.perf_counter() - t0
+
+    timed(2)
+    runs = []
+    for _ in range(reps):
+        t_lo = min(timed(k_lo) for _ in range(2))
+        t_hi = min(timed(k_hi) for _ in range(2))
+        runs.append(
+            (t_hi - t_lo) / (k_hi - k_lo) if t_hi > t_lo else t_hi / k_hi
+        )
+    return statistics.median(runs), sorted(runs)
+
+
+def main():
+    reps = 5
+    for a in sys.argv[1:]:
+        if a.startswith("--reps="):
+            reps = int(a.split("=")[1])
+    n, d, v = 8192, 512, 32768
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (n, d), jnp.bfloat16)
+    w = jax.random.normal(key, (d, v), jnp.bfloat16) * 0.02
+    y = jax.random.randint(key, (n,), 0, v)
+
+    variants = {
+        "xla_lean": lambda x, w, y: softmax_cross_entropy(
+            (x @ w).astype(jnp.float32), y
+        ),
+        # save_s=False EXPLICITLY: the default is None = auto, which at
+        # this shape resolves to the save-s mode — the lean arm must
+        # force the O(N) backward or it times save-s twice.
+        "fused_lean": lambda x, w, y: linear_cross_entropy(
+            x, w, y, save_s=False
+        ),
+        "fused_saves": lambda x, w, y: linear_cross_entropy(
+            x, w, y, save_s=True
+        ),
+    }
+    results = {}
+    for name, fn in variants.items():
+        med, runs = time_grad(fn, x, w, y, reps)
+        results[name] = (med, runs)
+        spread = (runs[-1] - runs[0]) / med
+        print(
+            f"{name:12s} median {med*1e3:7.3f} ms  "
+            f"runs {[round(r*1e3, 3) for r in runs]}  spread {spread:.1%}",
+            flush=True,
+        )
+
+    xla, _ = results["xla_lean"]
+    lean, _ = results["fused_lean"]
+    saves, saves_runs = results["fused_saves"]
+    # Decision rule: save-s earns default-on iff its WORST rep beats the
+    # competing variants' BEST rep — a jitter-proof separation.
+    best_other = min(results["xla_lean"][1][0], results["fused_lean"][1][0])
+    print(
+        f"\nsave-s worst {saves_runs[-1]*1e3:.3f} ms vs others' best "
+        f"{best_other*1e3:.3f} ms -> "
+        + ("SEPARATED: save-s wins beyond jitter"
+           if saves_runs[-1] < best_other else "NOT separated")
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -29,13 +29,16 @@ from tpudml.nn.layers import Dense, LayerNorm, Module
 
 
 # Bound on the one-hot transient the matmul backward materializes
-# (elements of [N, V] in dy.dtype). 64M elements is ~128 MB bf16 /
-# ~256 MB f32 — comfortably resident; past it the backward chunks the
-# token axis so memory stays O(cap + V·d) instead of O(N·V) (at the
-# 131k-token × 32k-vocab long-context regime the unchunked buffer would
-# be ~8.6 GB — exactly the O(N·V) blow-up the fused-xent head exists to
-# avoid).
-_ONEHOT_ELEM_CAP = 64 * 1024 * 1024
+# (elements of [N, V] in dy.dtype). 512M elements (~1 GiB bf16) keeps
+# the flagship (8k×32k = 2^28) and chip-filling (16k×32k = 2^29) configs
+# on the single-matmul fast path — chunking them was measured to cost
+# ~3 ms/step at the flagship (23.3 vs 20.3 ms, fori A/B on v5e: 128
+# sequential [2k, 32k] scan steps lose the big matmul's pipelining).
+# Past the cap the backward chunks the token axis so memory stays
+# O(cap + V·d) instead of O(N·V) — the 131k-token × 32k-vocab regime
+# (2^32 elements, ~8.6 GB unchunked) runs as 8 × 1 GiB chunks, exactly
+# the O(N·V) blow-up this bound exists to stop (ADVICE r4).
+_ONEHOT_ELEM_CAP = 512 * 1024 * 1024
 
 
 @jax.custom_vjp

@@ -19,16 +19,20 @@ classifier head:
   backward split:
   dX (V innermost): dx_tile += dlogits @ W_tileᵀ;
   dW (N innermost): dW_tile += x_tileᵀ @ dlogits.
-- backward, save-s mode (round 4; explicit ``save_s=True`` opt-in): the
-  forward additionally streams its f32 score tiles to HBM, and both
-  backward kernels read them instead of recomputing — the backward
-  drops from 4 matmuls' worth of MXU work to the 2 the cotangents
-  actually need (recomputing s cost ~2 ms at [8192,512]×[512,32k];
-  XLA's lean path wins at memory-fitting sizes for exactly this reason
-  — it keeps the logits). Saved scores are f32, so gradients are
-  bit-identical to the lean mode's recomputation. The trade is the O(N)
-  residual-memory contract above, which is why it is never a silent
-  default.
+- backward, save-s mode (round 4): the forward additionally streams its
+  f32 score tiles to HBM, and both backward kernels read them instead
+  of recomputing — the backward drops from 4 matmuls' worth of MXU work
+  to the 2 the cotangents actually need (recomputing s cost ~2 ms at
+  [8192,512]×[512,32k]; XLA's lean path wins at memory-fitting sizes
+  for exactly this reason — it keeps the logits). Saved scores are f32,
+  so gradients are bit-identical to the lean mode's recomputation. The
+  trade is an N_pad·V_pad·4-byte residual in place of the O(N)
+  contract; since round 5 the DEFAULT (``save_s=None``) picks the mode
+  automatically — save-s while that residual fits
+  ``SAVE_S_AUTO_MAX_BYTES`` (2 GiB), the lean O(N) contract beyond
+  (measured in-situ: save-s 19.29 ms/step vs lean 21.54 at the
+  flagship, BASELINE.md round 5). Pass ``save_s=False`` to force the
+  O(N) guarantee regardless of size.
 
 Exactness: same math as ``softmax_cross_entropy`` over the materialized
 logits (f32 statistics); pinned by tests against the XLA reference.
@@ -116,9 +120,7 @@ def _fused_forward(x, w, b, labels, block_n, block_v, interpret,
     n, d = x.shape
     d2, v = w.shape
     assert d == d2, (x.shape, w.shape)
-    block_n = min(block_n, _round_up(n, 8))
-    block_v = min(block_v, _round_up(v, 128))
-    n_pad, v_pad = _round_up(n, block_n), _round_up(v, block_v)
+    block_n, block_v, n_pad, v_pad = _padded_dims(n, v, block_n, block_v)
     xf = jnp.pad(x, ((0, n_pad - n), (0, 0))) if n_pad != n else x
     wf = jnp.pad(w, ((0, 0), (0, v_pad - v))) if v_pad != v else w
     bf = (jnp.pad(b, (0, v_pad - v)) if v_pad != v else b)[None, :]
@@ -229,9 +231,7 @@ def _bwd_prologue(x, w, labels, lse, block_n, block_v):
     dlogits exactly zero in every backward kernel."""
     n, d = x.shape
     _, v = w.shape
-    block_n = min(block_n, _round_up(n, 8))
-    block_v = min(block_v, _round_up(v, 128))
-    n_pad, v_pad = _round_up(n, block_n), _round_up(v, block_v)
+    block_n, block_v, n_pad, v_pad = _padded_dims(n, v, block_n, block_v)
     xf = jnp.pad(x, ((0, n_pad - n), (0, 0))) if n_pad != n else x
     wf = jnp.pad(w, ((0, 0), (0, v_pad - v))) if v_pad != v else w
     lf = jnp.pad(labels.astype(jnp.int32), (0, n_pad - n),
@@ -478,6 +478,35 @@ def _fused_bwd(block_n, block_v, interpret, save_s, res, g):
 _fused.defvjp(_fused_fwd, _fused_bwd)
 
 
+# save_s auto threshold (round 5, VERDICT r4 item 5): the speed mode's
+# f32 score residual is N_pad·V_pad·4 bytes; keep it on by default while
+# that stays a modest slice of v5e-class HBM (16 GB) and fall back to the
+# O(N) lean mode beyond. 2 GiB covers the flagship (8k×32k = 1 GiB) and
+# the chip-filling config (16k×32k = 2 GiB) with room for the model;
+# 131k-token long-context regimes (16 GiB of scores) auto-drop to lean —
+# exactly the regime the O(N) contract exists for. The speed win is
+# measured at kernel granularity by tools/xent_micro.py.
+SAVE_S_AUTO_MAX_BYTES = 2 * 1024**3
+
+
+def _padded_dims(n: int, v: int, block_n: int, block_v: int):
+    """The kernel tiling rule, in one place: clamp blocks to the
+    rounded-up problem (rows to 8, vocab to 128), pad the problem to a
+    block multiple. Every consumer — forward, backward prologue, and
+    the save-s auto threshold — must see the SAME (block_n, block_v,
+    n_pad, v_pad) or residual-size estimates drift from reality."""
+    block_n = min(block_n, _round_up(n, 8))
+    block_v = min(block_v, _round_up(v, 128))
+    return block_n, block_v, _round_up(n, block_n), _round_up(v, block_v)
+
+
+def _auto_save_s(n: int, v: int, block_n: int, block_v: int) -> bool:
+    """save_s=None resolution: speed mode iff the padded f32 score
+    residual fits the auto budget."""
+    _, _, n_pad, v_pad = _padded_dims(n, v, block_n, block_v)
+    return n_pad * v_pad * 4 <= SAVE_S_AUTO_MAX_BYTES
+
+
 def linear_cross_entropy(
     x: jax.Array,
     w: jax.Array,
@@ -487,7 +516,7 @@ def linear_cross_entropy(
     block_n: int = 256,
     block_v: int = 2048,
     interpret: bool | None = None,
-    save_s: bool = False,
+    save_s: bool | None = None,
 ) -> jax.Array:
     """Mean softmax cross-entropy of ``x @ w [+ bias]`` against integer
     ``labels`` without materializing the [N, V] logits (see module
@@ -497,17 +526,22 @@ def linear_cross_entropy(
     outside [0, V) contribute loss = lse (no pull-up) — mask such rows
     out beforehand. ``save_s=True`` is the SPEED mode: it keeps the
     [N_pad, V_pad] f32 scores as a backward residual (2 fewer backward
-    matmuls — measured 8.0 → 5.7 ms at [8192,512]×[512,32k]) but gives
-    up this kernel's O(N) residual-memory contract, so it is an explicit
-    opt-in, never a silent default. On non-TPU backends dispatches to
-    the XLA reference math unless ``interpret=True`` forces the Pallas
-    interpreter."""
+    matmuls — measured 8.0 → 5.7 ms at [8192,32k] in-situ, separated
+    from XLA jitter at kernel granularity by tools/xent_micro.py); the
+    default ``save_s=None`` resolves it AUTOMATICALLY: speed mode while
+    the score residual fits ``SAVE_S_AUTO_MAX_BYTES``, the O(N) lean
+    mode beyond (the long-context regimes the memory contract exists
+    for). Pass ``False`` to force the O(N) contract regardless. On
+    non-TPU backends dispatches to the XLA reference math unless
+    ``interpret=True`` forces the Pallas interpreter."""
     d = x.shape[-1]
     v = w.shape[-1]
     xn = x.reshape(-1, d)
     ln = labels.reshape(-1)
     if xn.shape[0] != ln.shape[0]:
         raise ValueError(f"{x.shape} rows != {labels.shape} labels")
+    if save_s is None:
+        save_s = _auto_save_s(xn.shape[0], v, block_n, block_v)
     if interpret is None:
         if jax.default_backend() != "tpu":
             # XLA fallback with the SAME out-of-range-label semantics as

@@ -393,7 +393,7 @@ class ContextParallel:
         aux_loss_weight: float | None = None,
         layout: str = "contiguous",
         fused_xent: bool = False,
-        save_scores: bool = False,
+        save_scores: bool | None = None,
     ):
         if layout not in ("contiguous", "striped"):
             raise ValueError(f"unknown layout {layout!r}")

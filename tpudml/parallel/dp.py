@@ -78,7 +78,7 @@ class DataParallel:
         stacked_batches: bool | None = None,
         aux_loss_weight: float | None = None,
         fused_xent: bool = False,
-        save_scores: bool = False,
+        save_scores: bool | None = None,
     ):
         if save_scores and not fused_xent:
             raise ValueError("save_scores requires fused_xent=True")

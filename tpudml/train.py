@@ -195,7 +195,7 @@ def make_train_step_body(
 
 def make_lm_fused_loss_fn(
     model: Module,
-    save_scores: bool = False,
+    save_scores: bool | None = None,
     aux_loss_weight: float | None = None,
 ) -> Callable:
     """(params, model_state, tokens, labels[, rng]) -> (loss, new_state)
@@ -231,7 +231,7 @@ def make_lm_fused_train_step_body(
     model: Module,
     optimizer: Optimizer,
     rng_root: jax.Array | None = None,
-    save_scores: bool = False,
+    save_scores: bool | None = None,
 ) -> Callable:
     """Un-jitted (ts, tokens, labels) -> (new_ts, metrics) body of
     :func:`make_lm_fused_train_step` — composable under ``lax.fori_loop``
@@ -260,7 +260,7 @@ def make_lm_fused_train_step(
     model: Module,
     optimizer: Optimizer,
     rng_root: jax.Array | None = None,
-    save_scores: bool = False,
+    save_scores: bool | None = None,
 ) -> Callable:
     """Jitted LM train step through the fused linear-cross-entropy kernel
     (``tpudml.ops.xent_kernel``): the [B·T, V] logits are never
