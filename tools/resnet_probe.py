@@ -71,10 +71,11 @@ def run_layers(peak):
         )
 
         def conv(x, wgt):
+            # Pure-bf16 conv matching the model's Conv2D (nn/layers.py:125 —
+            # no preferred_element_type; the MXU accumulates f32 internally).
             return jax.lax.conv_general_dilated(
                 x, wgt, (stride, stride), "SAME", dimension_numbers=dn,
-                preferred_element_type=jnp.float32,
-            ).astype(jnp.bfloat16)
+            )
 
         def fb(x, wgt):
             # fwd+bwd via vjp against a fixed-scale cotangent sum.
@@ -82,8 +83,9 @@ def run_layers(peak):
             return pull(y)  # dX and dW with dY = y (shape-right cotangent)
 
         f = conv_flops(ci, co, h, w, k, stride)
-        t_fwd = time_fn(f"{name} fwd", conv, x, wgt)
-        t_fb = time_fn(f"{name} fwd+bwd", fb, x, wgt)
+        # Sub-ms kernels: long fori windows so relay jitter differences out.
+        t_fwd = time_fn(f"{name} fwd", conv, x, wgt, iters_lo=24, iters_hi=96)
+        t_fb = time_fn(f"{name} fwd+bwd", fb, x, wgt, iters_lo=24, iters_hi=96)
         eff_f = f / t_fwd / peak
         # fwd+bwd = 3x fwd FLOPs (dX + dW each equal the fwd contraction)
         eff_fb = 3 * f / t_fb / peak
@@ -113,7 +115,9 @@ def run_bn(peak):
         params, state = bn.init(jax.random.PRNGKey(2))
 
         def bnrelu(x):
-            y, st = bn.apply(params, state, x.astype(jnp.float32), train=True)
+            # Model convention (BasicBlock._bn): BN consumes the bf16 stream
+            # directly; stats accumulate in f32 inside BatchNorm.apply.
+            y, st = bn.apply(params, state, x, train=True)
             return jax.nn.relu(y).astype(jnp.bfloat16), st["mean"]
 
         time_fn(f"bn+relu {ch}ch @{h}x{h}", bnrelu, x)

@@ -95,8 +95,11 @@ class BasicBlock(Module):
         return jax.nn.relu(y + shortcut), new_state
 
     def _bn(self, bn, params, state, x, train):
-        # BN in float32 regardless of compute dtype, back-cast afterwards.
-        y, new_state = bn.apply(params, state, x.astype(jnp.float32), train=train)
+        # BN stats/normalize run in f32 INSIDE BatchNorm.apply (f32-accumulated
+        # reductions straight off the bf16 stream); pre-casting here would
+        # materialize an f32 copy of the activation and double the HBM traffic
+        # of every stat pass.
+        y, new_state = bn.apply(params, state, x, train=train)
         return y.astype(self.compute_dtype), new_state
 
 
@@ -152,9 +155,9 @@ class BottleneckBlock(Module):
         return params, state
 
     def _bn(self, ch, params, state, x, train):
-        y, new_state = BatchNorm(ch).apply(
-            params, state, x.astype(jnp.float32), train=train
-        )
+        # No f32 pre-cast — BatchNorm.apply accumulates its stats in f32 off
+        # the bf16 stream (see BasicBlock._bn).
+        y, new_state = BatchNorm(ch).apply(params, state, x, train=train)
         return y.astype(self.compute_dtype), new_state
 
     def apply(self, params, state, x, *, train=False, rng=None):
@@ -243,7 +246,7 @@ class ResNet(Module):
         y, _ = stem.apply(_cast(params["stem"], cdt), {}, x)
         bn = BatchNorm(self.width)
         y, new_state["stem_bn"] = bn.apply(
-            params["stem_bn"], state["stem_bn"], y.astype(jnp.float32), train=train
+            params["stem_bn"], state["stem_bn"], y, train=train
         )
         y = jax.nn.relu(y).astype(cdt)
         if self.stem == "imagenet":

@@ -209,18 +209,33 @@ class BatchNorm(Module):
     def apply(self, params, state, x, *, train=False, rng=None):
         axes = tuple(range(x.ndim - 1))
         if train:
-            mean = jnp.mean(x, axes)
-            var = jnp.var(x, axes)
+            # Single-pass moments in f32 accumulated straight off the (possibly
+            # bf16) stream: sum and sum-of-squares reduce in ONE fused read of
+            # x instead of jnp.var's mean-then-deviations second pass, and the
+            # stream is never materialized as an f32 copy. Same clamped
+            # E[x²] − m² form as LayerNorm (cancellation can go slightly
+            # negative in f32; rsqrt(negative + eps) would NaN-poison the step).
+            mean = jnp.mean(x, axes, dtype=jnp.float32)
+            var = jnp.maximum(
+                jnp.mean(jnp.square(x.astype(jnp.float32)), axes) - jnp.square(mean),
+                0.0,
+            )
             m = self.momentum
             new_state = {
-                "mean": m * state["mean"] + (1 - m) * mean,
-                "var": m * state["var"] + (1 - m) * var,
+                "mean": m * state["mean"] + (1 - m) * mean.astype(state["mean"].dtype),
+                "var": m * state["var"] + (1 - m) * var.astype(state["var"].dtype),
             }
         else:
             mean, var = state["mean"], state["var"]
             new_state = state
-        y = (x - mean) * lax.rsqrt(var + self.eps)
-        return y * params["scale"] + params["bias"], new_state
+        # Normalization in f32 (scale/bias params are f32), back in x's dtype —
+        # pure elementwise, so XLA fuses the cast/normalize/cast chain into the
+        # neighbouring ops; a bf16 compute path stays bf16 end to end.
+        y = (x.astype(jnp.float32) - mean) * lax.rsqrt(
+            var.astype(jnp.float32) + self.eps
+        )
+        y = y * params["scale"].astype(jnp.float32) + params["bias"].astype(jnp.float32)
+        return y.astype(x.dtype), new_state
 
 
 @dataclass(frozen=True)

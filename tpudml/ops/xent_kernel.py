@@ -526,8 +526,8 @@ def linear_cross_entropy(
     outside [0, V) contribute loss = lse (no pull-up) — mask such rows
     out beforehand. ``save_s=True`` is the SPEED mode: it keeps the
     [N_pad, V_pad] f32 scores as a backward residual (2 fewer backward
-    matmuls — measured 8.0 → 5.7 ms at [8192,32k] in-situ, separated
-    from XLA jitter at kernel granularity by tools/xent_micro.py); the
+    matmuls — 8.21 → 5.97 ms at [8192,32k] at kernel granularity,
+    tools/xent_micro.py; 21.54 → 19.29 ms/step in-situ); the
     default ``save_s=None`` resolves it AUTOMATICALLY: speed mode while
     the score residual fits ``SAVE_S_AUTO_MAX_BYTES``, the O(N) lean
     mode beyond (the long-context regimes the memory contract exists
