@@ -411,3 +411,31 @@ def test_ep_composes_with_dp():
     ref_logits = dense_model(ref_ts.params, images)
     ref_acc = float(jnp.mean(jnp.argmax(ref_logits, -1) == labels))
     np.testing.assert_allclose(acc, ref_acc, atol=1e-6)
+
+
+@pytest.mark.parametrize("top_k,cap", [(1, 8.0), (2, 8.0), (1, E / G), (2, 0.5)])
+def test_gather_matches_einsum_dispatch(tokens, top_k, cap):
+    """The gather dispatch (default) and the GShard one-hot einsum oracle
+    consume the identical slot assignment, so outputs AND gradients —
+    router included, through the gate/combine path — must agree to f32
+    tolerance, with and without capacity drops."""
+    kw = dict(mlp_ratio=2, capacity_factor=cap, top_k=top_k)
+    gather = MoELayer(D, E, **kw)  # dispatch="gather" default
+    einsum = MoELayer(D, E, dispatch="einsum", **kw)
+    params, _ = gather.init(seed_key(1))
+
+    def loss(moe, params, x):
+        y, st = moe.apply(params, {}, x)
+        return jnp.sum(y**2) + st["aux_loss"], y
+
+    (lg, yg), gg = jax.value_and_grad(lambda p, x: loss(gather, p, x), (0, 1), has_aux=True)(params, tokens)
+    (le, ye), ge = jax.value_and_grad(lambda p, x: loss(einsum, p, x), (0, 1), has_aux=True)(params, tokens)
+    np.testing.assert_allclose(np.asarray(yg), np.asarray(ye), rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(float(lg), float(le), rtol=2e-5)
+    for a, b in zip(jax.tree.leaves(gg), jax.tree.leaves(ge)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-6)
+
+
+def test_gather_dispatch_validation():
+    with pytest.raises(ValueError):
+        MoELayer(D, E, dispatch="loop")

@@ -76,22 +76,23 @@ def main():
     sec_dense = record(TransformerLM(**base), "dense FFN (baseline)", x, y, on_tpu)
     for e in (4, 8):
         for cap in (1.25, 2.0):
-            sec = record(
-                TransformerLM(
-                    **base, moe_experts=e, moe_top_k=1,
-                    moe_capacity_factor=cap,
-                ),
-                f"MoE E={e} top-1 cap={cap}", x, y, on_tpu,
-            )
             keep, util = capacity_probe(
                 base["embed_dim"], e, cap, x.shape[0] * x.shape[1]
             )
-            print(
-                f"    -> dispatch overhead {1e3*(sec - sec_dense):+.2f} ms/step "
-                f"({sec/sec_dense:.2f}x dense); token keep-rate {keep:.1%}, "
-                f"slot utilization {util:.1%} (router at init)",
-                flush=True,
-            )
+            for dispatch in ("einsum", "gather"):
+                sec = record(
+                    TransformerLM(
+                        **base, moe_experts=e, moe_top_k=1,
+                        moe_capacity_factor=cap, moe_dispatch=dispatch,
+                    ),
+                    f"MoE E={e} top-1 cap={cap} [{dispatch}]", x, y, on_tpu,
+                )
+                print(
+                    f"    -> dispatch overhead {1e3*(sec - sec_dense):+.2f} ms/step "
+                    f"({sec/sec_dense:.2f}x dense); token keep-rate {keep:.1%}, "
+                    f"slot utilization {util:.1%} (router at init)",
+                    flush=True,
+                )
 
 
 def capacity_probe(d, experts, cap_factor, n_tokens):

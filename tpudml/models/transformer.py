@@ -136,6 +136,7 @@ class TransformerBlock(Module):
     moe_axis: str | None = None
     moe_capacity_factor: float = 2.0
     moe_top_k: int = 1
+    moe_dispatch: str = "gather"
     # Fuse the block's ln2 junction (x + attn_out → LayerNorm) into one
     # add+LN Pallas kernel per direction. This is the PIPELINE-stage form
     # of the LM's deferred trunk: the block keeps its shape-preserving
@@ -185,6 +186,7 @@ class TransformerBlock(Module):
                 capacity_factor=self.moe_capacity_factor,
                 top_k=self.moe_top_k,
                 axis_name=self.moe_axis,
+                dispatch=self.moe_dispatch,
                 dtype=self.dtype,
             )
         else:
@@ -347,6 +349,7 @@ class TransformerLM(Module):
     moe_axis: str | None = None
     moe_capacity_factor: float = 2.0
     moe_top_k: int = 1
+    moe_dispatch: str = "gather"
     dtype: Any = jnp.float32
     # Fused residual-add + LayerNorm junctions (tpudml.ops.layernorm_kernel
     # .fused_add_layernorm): the trunk defers each block's closing residual
@@ -399,6 +402,7 @@ class TransformerLM(Module):
             moe_axis=self.moe_axis,
             moe_capacity_factor=self.moe_capacity_factor,
             moe_top_k=self.moe_top_k,
+            moe_dispatch=self.moe_dispatch,
             dtype=self.dtype,
         )
 

@@ -1,15 +1,23 @@
 """Mixture-of-Experts layer with expert parallelism.
 
 Absent from the reference (SURVEY.md §2.3 lists EP/MoE as out of parity
-scope), built here to complete the parallelism matrix. TPU-first design —
-the GShard/Switch dense-dispatch formulation, not per-token gather loops:
+scope), built here to complete the parallelism matrix. TPU-first design:
 
 - top-k routing (k=1 Switch, k>1 GShard) with a static per-shard expert
-  capacity C, so every shape is fixed and XLA tiles the dispatch/combine
-  einsums onto the MXU;
-- dispatch is a [G, E, C] one-hot tensor: ``expert_in = einsum(
-  'gec,gd->ecd')``, combine is its gate-weighted transpose — tokens past
-  capacity are dropped (combine weight 0), the standard Switch trade;
+  capacity C, so every shape is fixed under jit;
+- dispatch/combine are static-shape ROW GATHERS over a flat slot index
+  (default ``dispatch="gather"``): the choice-priority cumsum assigns each
+  (token, choice) a flat slot in [0, E·C) (sentinel when capacity-dropped),
+  dispatch gathers token rows into [E, C, d], combine gathers each token's
+  k expert outputs back, gate-weighted. The slot map is injective, so both
+  backwards are the INVERSE gather (custom VJPs — no row scatter-adds, no
+  [G, E, C] one-hot buffers, no O(G·E·C·d) einsum FLOPs). The GShard
+  one-hot einsum formulation survives as ``dispatch="einsum"``, the parity
+  oracle: both paths consume the identical slot assignment. Measured on a
+  v5e (tools/moe_perf.py): the einsum dispatch cost ~1.9-2.5× dense at
+  matched active FLOPs; gather removes that overhead (recording in
+  BASELINE.md round 5). Tokens past capacity are dropped (combine weight
+  0), the standard Switch trade;
 - under expert parallelism (``axis_name`` set, run inside shard_map),
   tokens AND experts are sharded over the same mesh axis: each shard
   routes its local tokens, one ``all_to_all`` ships the [E, C, d] dispatch
@@ -35,6 +43,86 @@ from jax import lax
 from tpudml.nn.layers import Module, _uniform_fan_in
 
 
+def _pad0(rows):
+    """Append one zero row — the landing pad for sentinel indices."""
+    return jnp.concatenate([rows, jnp.zeros((1, rows.shape[-1]), rows.dtype)], 0)
+
+
+@jax.custom_vjp
+def _permute_rows(tokens_pad, token_src, flat_dst):
+    """Dispatch gather: out[s] = tokens_pad[token_src[s]] for every expert
+    slot s (``token_src`` sentinel = G hits the appended zero row).
+
+    The slot assignment is INJECTIVE — each slot holds at most one
+    (token, choice) and each (token, choice) owns at most one slot — so
+    the backward is the inverse gather over ``flat_dst`` [G, k] (sentinel
+    = S), never a scatter-add of [*, d] rows (the op autodiff would emit
+    for ``take``, which serializes on TPU — the same finding that moved
+    the embedding backward to an MXU matmul in round 4)."""
+    return jnp.take(tokens_pad, token_src, axis=0)
+
+
+def _permute_rows_fwd(tokens_pad, token_src, flat_dst):
+    return _permute_rows(tokens_pad, token_src, flat_dst), (
+        flat_dst,
+        tokens_pad.shape[0],
+    )
+
+
+def _permute_rows_bwd(res, dy):
+    flat_dst, n_pad = res
+    # dTokens[g] = Σ_j dy[flat_dst[g, j]]; sentinel rides the zero row.
+    d_tok = jnp.sum(jnp.take(_pad0(dy), flat_dst, axis=0), axis=1)
+    d_pad = jnp.zeros((n_pad - d_tok.shape[0], dy.shape[-1]), dy.dtype)
+    return jnp.concatenate([d_tok, d_pad], 0), None, None
+
+
+_permute_rows.defvjp(_permute_rows_fwd, _permute_rows_bwd)
+
+
+@jax.custom_vjp
+def _combine_rows(expert_flat, w, flat_dst, token_src):
+    """Combine gather: y[g] = Σ_j w[g, j] · expert_flat[flat_dst[g, j]]
+    (gate-weighted return of each token's k expert outputs; dropped
+    choices carry w = 0 and a sentinel index onto the zero row).
+
+    Backward wrt ``expert_flat`` is again the inverse gather — slot s's
+    cotangent is w_at_slot[s] · dy[token_src[s]] — computed via a [S]
+    scalar scatter of the gate values (tiny) plus one row gather."""
+    rows = jnp.take(_pad0(expert_flat), flat_dst, axis=0)  # [G, k, d]
+    return jnp.einsum("gk,gkd->gd", w, rows.astype(w.dtype))
+
+
+def _combine_rows_fwd(expert_flat, w, flat_dst, token_src):
+    return _combine_rows(expert_flat, w, flat_dst, token_src), (
+        expert_flat,
+        w,
+        flat_dst,
+        token_src,
+    )
+
+
+def _combine_rows_bwd(res, dy):
+    expert_flat, w, flat_dst, token_src = res
+    s_total = expert_flat.shape[0]
+    # Re-gather the rows (cheaper than holding [G, k, d] as a residual).
+    rows = jnp.take(_pad0(expert_flat), flat_dst, axis=0)
+    dw = jnp.einsum("gd,gkd->gk", dy, rows.astype(dy.dtype)).astype(w.dtype)
+    # Gate value seen by each slot: a [S]-scalar scatter (collisions only
+    # on the sliced-off sentinel row).
+    w_src = (
+        jnp.zeros((s_total + 1,), w.dtype)
+        .at[flat_dst.reshape(-1)]
+        .set(w.reshape(-1))[:s_total]
+    )
+    dy_tok = jnp.take(_pad0(dy), token_src, axis=0)  # [S, d]
+    d_expert = (w_src[:, None] * dy_tok).astype(expert_flat.dtype)
+    return d_expert, dw, None, None
+
+
+_combine_rows.defvjp(_combine_rows_fwd, _combine_rows_bwd)
+
+
 @dataclass(frozen=True)
 class MoELayer(Module):
     """Top-k mixture-of-experts FFN over [..., embed_dim] inputs.
@@ -57,12 +145,20 @@ class MoELayer(Module):
     top_k: int = 1
     axis_name: str | None = None
     dtype: Any = jnp.float32
+    # "gather": slot-index dispatch/combine via row gathers with
+    # inverse-gather backwards — O(S·d) data movement, no O(G·E·C·d)
+    # FLOPs and no [G, E, C] buffers. "einsum": the GShard one-hot
+    # formulation, kept as the parity oracle (identical routing by
+    # construction — both consume the same flat_dst slot assignment).
+    dispatch: str = "gather"
 
     def __post_init__(self):
         if not 1 <= self.top_k <= self.num_experts:
             raise ValueError(
                 f"top_k {self.top_k} must be in [1, num_experts={self.num_experts}]"
             )
+        if self.dispatch not in ("gather", "einsum"):
+            raise ValueError(f"dispatch must be 'gather' or 'einsum', got {self.dispatch!r}")
 
     def init(self, key):
         d, e, h = self.embed_dim, self.num_experts, self.mlp_ratio * self.embed_dim
@@ -104,32 +200,59 @@ class MoELayer(Module):
         else:
             gates = topv / (jnp.sum(topv, axis=-1, keepdims=True) + 1e-9)
 
-        # Choice-priority dispatch: choice 0 claims buffer slots for ALL
-        # tokens before choice 1 sees the remaining capacity (k static and
-        # small, so the Python loop unrolls into k fused dispatch builds).
-        # Bookkeeping stays float32 regardless of the token dtype — bf16
-        # represents integers exactly only to 256, so a bf16 cumsum would
-        # corrupt capacity positions on any real batch.
+        # Choice-priority slot assignment: choice 0 claims buffer slots for
+        # ALL tokens before choice 1 sees the remaining capacity (k static
+        # and small, so the Python loop unrolls). Bookkeeping stays float32
+        # regardless of the token dtype — bf16 represents integers exactly
+        # only to 256, so a bf16 cumsum would corrupt capacity positions on
+        # any real batch. Output: flat_dst [G, k] — each (token, choice)'s
+        # flat slot id e·cap + slot, sentinel S = E·cap when dropped.
+        s_total = e * cap
         counts = jnp.zeros((e,), jnp.float32)  # slots used per expert
-        disp = jnp.zeros((g, e, cap), jnp.float32)
-        combine = jnp.zeros((g, e, cap), jnp.float32)
         choice_sum = jnp.zeros((g, e), jnp.float32)  # Σ_j onehot_j per token
+        flat_dst = []
+        kept_flags = []
         for j in range(self.top_k):
             onehot = jax.nn.one_hot(topi[:, j], e, dtype=jnp.float32)  # [G, E]
             choice_sum = choice_sum + onehot
             pos = counts[None, :] + jnp.cumsum(onehot, axis=0) - onehot  # [G, E]
             kept = onehot * (pos < cap)
             slot = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)
-            disp_j = kept[:, :, None] * jax.nn.one_hot(slot, cap, dtype=jnp.float32)[
-                :, None, :
-            ]  # [G, E, C] (disjoint slots across choices by construction)
-            disp = disp + disp_j
-            combine = combine + disp_j * gates[:, j][:, None, None]
+            kept_g = jnp.sum(kept, axis=-1)  # [G] ∈ {0, 1}
+            flat_dst.append(
+                jnp.where(kept_g > 0, topi[:, j] * cap + slot, s_total).astype(
+                    jnp.int32
+                )
+            )
+            kept_flags.append(kept_g)
             counts = counts + jnp.sum(kept, axis=0)
+        flat_dst = jnp.stack(flat_dst, axis=1)  # [G, k]
+        w_eff = gates * jnp.stack(kept_flags, axis=1).astype(gates.dtype)  # [G, k]
 
-        expert_in = jnp.einsum(
-            "gec,gd->ecd", disp.astype(tokens.dtype), tokens
-        )  # [E, C, d]
+        if self.dispatch == "gather":
+            # Invert the injective (token, choice) → slot map with a [G·k]
+            # int32 scatter (tiny; collisions land only on the sentinel
+            # row, which the slice drops), then dispatch = one row gather.
+            token_src = (
+                jnp.full((s_total + 1,), g, jnp.int32)
+                .at[flat_dst.reshape(-1)]
+                .set(jnp.repeat(jnp.arange(g, dtype=jnp.int32), self.top_k))[:s_total]
+            )
+            expert_in = _permute_rows(_pad0(tokens), token_src, flat_dst).reshape(
+                e, cap, d
+            )
+        else:
+            # GShard one-hot materialization of the SAME slot assignment:
+            # [G, k, S] one-hots reduce to the classic [G, E, C] dispatch /
+            # combine tensors (O(G·E·C·d) einsum FLOPs — the parity oracle).
+            oh = jax.nn.one_hot(flat_dst, s_total + 1, dtype=jnp.float32)[
+                :, :, :s_total
+            ]
+            disp = jnp.sum(oh, axis=1).reshape(g, e, cap)
+            combine = jnp.einsum("gks,gk->gs", oh, w_eff).reshape(g, e, cap)
+            expert_in = jnp.einsum(
+                "gec,gd->ecd", disp.astype(tokens.dtype), tokens
+            )  # [E, C, d]
         ep = self.axis_name is not None
         if ep:
             # Ship each expert's buffer to its owning shard: [E, C, d] →
@@ -148,7 +271,14 @@ class MoELayer(Module):
             expert_out = lax.all_to_all(
                 expert_out, self.axis_name, split_axis=1, concat_axis=0, tiled=True
             )
-        y = jnp.einsum("gec,ecd->gd", combine.astype(expert_out.dtype), expert_out)
+        if self.dispatch == "gather":
+            y = _combine_rows(
+                expert_out.reshape(s_total, d), w_eff, flat_dst, token_src
+            ).astype(tokens.dtype)
+        else:
+            y = jnp.einsum(
+                "gec,ecd->gd", combine.astype(expert_out.dtype), expert_out
+            )
         # Switch/GShard aux loss over this shard's tokens: E · Σ_e frac_e ·
         # p̄_e, with frac_e the dispatch fraction averaged over ALL k
         # choices (GShard's formulation; =1 when routing is uniform).
