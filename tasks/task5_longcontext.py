@@ -138,6 +138,12 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--moe_experts", type=int, default=0, help="MoE FFN experts")
     p.add_argument("--moe_top_k", type=int, default=1,
                    help="experts per token (1=Switch, 2=GShard)")
+    p.add_argument("--moe_dispatch", choices=("gather", "einsum", "ragged"),
+                   default="gather",
+                   help="expert dispatch: gather (speed default), einsum "
+                        "(GShard one-hot oracle), ragged (DROPLESS "
+                        "lax.ragged_dot grouped matmuls — single-shard only, "
+                        "rejects --parallel ep)")
     p.add_argument("--dropout", type=float, default=0.0)
     p.add_argument("--steps", type=int, default=60)
     p.add_argument("--lr", type=float, default=1e-3)
@@ -196,6 +202,7 @@ def build_engine(args, devices):
         remat=args.remat,
         moe_experts=args.moe_experts,
         moe_top_k=args.moe_top_k,
+        moe_dispatch=args.moe_dispatch,
         dropout=args.dropout,
         fused_ln=args.fused_ln,
     )

@@ -439,3 +439,48 @@ def test_gather_matches_einsum_dispatch(tokens, top_k, cap):
 def test_gather_dispatch_validation():
     with pytest.raises(ValueError):
         MoELayer(D, E, dispatch="loop")
+
+
+@pytest.mark.parametrize("top_k", [1, 2])
+def test_ragged_matches_direct_mixture(tokens, top_k):
+    """dispatch='ragged' is DROPLESS: every token reaches all its chosen
+    experts regardless of load imbalance, so the direct per-token mixture
+    is an exact oracle (no ample-capacity caveat) — outputs, aux loss,
+    and all gradients."""
+    moe = MoELayer(D, E, mlp_ratio=2, top_k=top_k, dispatch="ragged")
+    params, _ = moe.init(seed_key(4))
+
+    probs = jax.nn.softmax(tokens @ params["router"]["kernel"], -1)
+    topv, topi = jax.lax.top_k(probs, top_k)
+    gates = topv if top_k == 1 else topv / (jnp.sum(topv, -1, keepdims=True) + 1e-9)
+    w = params["experts"]
+
+    def ffn(e, t):
+        h = jax.nn.relu(t @ w["w1"][e] + w["b1"][e])
+        return h @ w["w2"][e] + w["b2"][e]
+
+    y, _ = moe.apply(params, {}, tokens)
+    want = jnp.stack([
+        sum(gates[i, j] * ffn(int(topi[i, j]), tokens[i]) for j in range(top_k))
+        for i in range(G)
+    ])
+    np.testing.assert_allclose(np.asarray(y), np.asarray(want), rtol=2e-5, atol=2e-6)
+
+    # Gradients vs the high-capacity gather path (nothing drops there, so
+    # the two formulations compute the same function).
+    ref = MoELayer(D, E, mlp_ratio=2, capacity_factor=8.0, top_k=top_k)
+
+    def loss(moe, params, x):
+        y, st = moe.apply(params, {}, x)
+        return jnp.sum(y**2) + st["aux_loss"]
+
+    lr_, gr = jax.value_and_grad(lambda p, x: loss(moe, p, x), (0, 1))(params, tokens)
+    le_, ge = jax.value_and_grad(lambda p, x: loss(ref, p, x), (0, 1))(params, tokens)
+    np.testing.assert_allclose(float(lr_), float(le_), rtol=2e-5)
+    for a, b in zip(jax.tree.leaves(gr), jax.tree.leaves(ge)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-6)
+
+
+def test_ragged_rejects_ep():
+    with pytest.raises(ValueError, match="single-shard"):
+        MoELayer(D, E, dispatch="ragged", axis_name="expert")

@@ -48,6 +48,14 @@ def _pad0(rows):
     return jnp.concatenate([rows, jnp.zeros((1, rows.shape[-1]), rows.dtype)], 0)
 
 
+def _switch_aux(frac, probs, num_experts):
+    """Switch/GShard load-balance loss E · Σ_e frac_e · p̄_e (=1 uniform).
+    ``frac`` is the per-expert dispatch fraction averaged over all k
+    choices — shared by every dispatch branch so the formulation cannot
+    silently diverge between them."""
+    return num_experts * jnp.sum(frac * jnp.mean(probs, axis=0))
+
+
 @jax.custom_vjp
 def _permute_rows(tokens_pad, token_src, flat_dst):
     """Dispatch gather: out[s] = tokens_pad[token_src[s]] for every expert
@@ -150,6 +158,9 @@ class MoELayer(Module):
     # FLOPs and no [G, E, C] buffers. "einsum": the GShard one-hot
     # formulation, kept as the parity oracle (identical routing by
     # construction — both consume the same flat_dst slot assignment).
+    # "ragged": DROPLESS — tokens sorted by expert feed lax.ragged_dot
+    # grouped matmuls; no capacity, no drops, no padded slots (single-
+    # shard only: EP's all_to_all needs the static capacity buffers).
     dispatch: str = "gather"
 
     def __post_init__(self):
@@ -157,8 +168,17 @@ class MoELayer(Module):
             raise ValueError(
                 f"top_k {self.top_k} must be in [1, num_experts={self.num_experts}]"
             )
-        if self.dispatch not in ("gather", "einsum"):
-            raise ValueError(f"dispatch must be 'gather' or 'einsum', got {self.dispatch!r}")
+        if self.dispatch not in ("gather", "einsum", "ragged"):
+            raise ValueError(
+                f"dispatch must be 'gather', 'einsum', or 'ragged', got {self.dispatch!r}"
+            )
+        if self.dispatch == "ragged" and self.axis_name is not None:
+            raise ValueError(
+                "dispatch='ragged' is single-shard only — expert parallelism "
+                "ships static [E, C, d] capacity buffers over all_to_all, "
+                "which the dropless path deliberately does not build; use "
+                "dispatch='gather' under EP"
+            )
 
     def init(self, key):
         d, e, h = self.embed_dim, self.num_experts, self.mlp_ratio * self.embed_dim
@@ -199,6 +219,13 @@ class MoELayer(Module):
             gates = topv  # Switch: the raw top-1 probability
         else:
             gates = topv / (jnp.sum(topv, axis=-1, keepdims=True) + 1e-9)
+
+        if self.dispatch == "ragged":
+            y = self._ragged_ffn(params["experts"], tokens, topi, gates)
+            frac = jnp.mean(
+                jnp.sum(jax.nn.one_hot(topi, e, dtype=jnp.float32), axis=1), axis=0
+            ) / self.top_k
+            return y.reshape(shape), {"aux_loss": _switch_aux(frac, probs, e)}
 
         # Choice-priority slot assignment: choice 0 claims buffer slots for
         # ALL tokens before choice 1 sees the remaining capacity (k static
@@ -279,15 +306,57 @@ class MoELayer(Module):
             y = jnp.einsum(
                 "gec,ecd->gd", combine.astype(expert_out.dtype), expert_out
             )
-        # Switch/GShard aux loss over this shard's tokens: E · Σ_e frac_e ·
-        # p̄_e, with frac_e the dispatch fraction averaged over ALL k
-        # choices (GShard's formulation; =1 when routing is uniform).
-        # First-choice-only frac (ADVICE r2) would leave secondary-choice
-        # expert collapse invisible to the loss; differentiable through
-        # probs.
+        # Aux loss over this shard's tokens, frac averaged over ALL k
+        # choices (first-choice-only frac — ADVICE r2 — would leave
+        # secondary-choice expert collapse invisible); differentiable
+        # through probs.
         frac = jnp.mean(choice_sum, axis=0) / self.top_k
-        aux = self.num_experts * jnp.sum(frac * jnp.mean(probs, axis=0))
-        return y.reshape(shape), {"aux_loss": aux}
+        return y.reshape(shape), {"aux_loss": _switch_aux(frac, probs, e)}
+
+    def _ragged_ffn(self, w, tokens, topi, gates):
+        """Dropless grouped-matmul expert FFN (``dispatch="ragged"``).
+
+        (token, choice) pairs are sorted by expert id; ``lax.ragged_dot``
+        runs each expert's contiguous row block through its weights — no
+        capacity buffers, no dropped tokens, no padded slots computing on
+        zeros. The sort permutation is injective and total, so both the
+        dispatch and the un-sort are `_permute_rows` gathers (backwards are
+        the inverse gathers). Biases ride a [P, E] one-hot MATMUL rather
+        than a row gather, so their backward is an MXU matmul instead of a
+        scatter-add onto [E, ·] rows.
+        """
+        g, d = tokens.shape
+        e, k = self.num_experts, self.top_k
+        p = g * k  # (token, choice) pairs
+        eids = topi.reshape(p)  # pair -> expert, pair id = g·k + j
+        # Stable argsort keeps same-expert pairs in token order.
+        order = jnp.argsort(eids)  # [P] sorted position -> pair id
+        inv = (
+            jnp.zeros((p,), jnp.int32)
+            .at[order]
+            .set(jnp.arange(p, dtype=jnp.int32))
+        )  # pair id -> sorted position
+        group_sizes = jnp.bincount(eids, length=e).astype(jnp.int32)
+
+        token_src = (order // k).astype(jnp.int32)  # sorted position -> token
+        flat_dst = inv.reshape(g, k)  # token -> its k sorted positions
+        x_sorted = _permute_rows(_pad0(tokens), token_src, flat_dst)  # [P, d]
+
+        # ragged_dot wants matching operand dtypes; promote like einsum would.
+        ct = jnp.promote_types(x_sorted.dtype, w["w1"].dtype)
+        onehot = jax.nn.one_hot(eids[order], e, dtype=ct)  # [P, E]
+        hidden = jax.nn.relu(
+            lax.ragged_dot(x_sorted.astype(ct), w["w1"].astype(ct), group_sizes)
+            + onehot @ w["b1"].astype(ct)
+        )
+        out_sorted = lax.ragged_dot(
+            hidden, w["w2"].astype(ct), group_sizes
+        ) + onehot @ w["b2"].astype(ct)
+        # Gate-weighted un-sort: the same injective-map combine as the
+        # gather dispatch, with every choice kept (w_eff = gates).
+        return _combine_rows(out_sorted, gates, flat_dst, token_src).astype(
+            tokens.dtype
+        )
 
 
 def load_balancing_loss(params: dict, x: jax.Array, num_experts: int) -> jax.Array:
@@ -299,4 +368,4 @@ def load_balancing_loss(params: dict, x: jax.Array, num_experts: int) -> jax.Arr
     frac = jnp.mean(
         jax.nn.one_hot(jnp.argmax(probs, -1), num_experts, dtype=probs.dtype), axis=0
     )
-    return num_experts * jnp.sum(frac * jnp.mean(probs, axis=0))
+    return _switch_aux(frac, probs, num_experts)
