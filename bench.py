@@ -272,7 +272,13 @@ def _analytic_lm_flops(cfg, batch: int, seq_len: int) -> float:
     norm/embedding-gather work excluded. GQA (``num_kv_heads``) shrinks
     the k/v projections to 2·d·(kv_heads·dh)."""
     d, L, V = cfg["embed_dim"], cfg["num_layers"], cfg["vocab_size"]
-    heads = cfg["num_heads"]
+    # num_heads only matters under GQA (kv_heads < heads shrinks the k/v
+    # projections); MHA callers (tools/ablate_lm.py) may omit both. A cfg
+    # with kv_heads but no heads would silently inflate the k/v term under
+    # the heads=1 fallback (dh would be d), so reject it loudly.
+    heads = cfg.get("num_heads") or 1
+    if cfg.get("num_kv_heads") and not cfg.get("num_heads"):
+        raise ValueError("cfg sets num_kv_heads but not num_heads")
     kv_heads = cfg.get("num_kv_heads") or heads
     dh = d // heads
     tokens = batch * seq_len

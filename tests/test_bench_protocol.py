@@ -71,3 +71,27 @@ def test_time_fori_degenerate_fallback(monkeypatch):
     )
     assert abs(sec - 1.0 / 6) < 1e-9
     assert runs == [sec]
+
+
+def test_analytic_lm_flops_mha_callers_may_omit_heads():
+    """tools/ablate_lm.py passes only embed_dim/num_layers/vocab_size; the
+    GQA extension must not make num_heads required (heads only matter when
+    kv_heads differs), and the MHA count must be head-count independent."""
+    base = dict(embed_dim=512, num_layers=6, vocab_size=32768)
+    f_plain = bench._analytic_lm_flops(base, 8, 1024)
+    f_mha = bench._analytic_lm_flops({**base, "num_heads": 4}, 8, 1024)
+    assert f_plain == f_mha > 0
+    f_gqa = bench._analytic_lm_flops(
+        {**base, "num_heads": 4, "num_kv_heads": 1}, 8, 1024
+    )
+    assert f_gqa < f_mha  # GQA shrinks the k/v projections
+
+
+def test_analytic_lm_flops_rejects_kv_heads_without_heads():
+    import pytest
+
+    with pytest.raises(ValueError, match="num_kv_heads"):
+        bench._analytic_lm_flops(
+            dict(embed_dim=512, num_layers=6, vocab_size=32768, num_kv_heads=2),
+            8, 1024,
+        )
