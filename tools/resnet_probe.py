@@ -1,5 +1,6 @@
-"""ResNet-18 ceiling investigation (VERDICT r4 item 2): per-layer conv
-timing + HLO dump + targeted experiments, on the real chip.
+"""ResNet ceiling investigation (VERDICT r4 item 2): per-layer conv
+timing + HLO dump + targeted experiments, on the real chip —
+ResNet-18 (``layers``/``bn``/``block``/``hlo``) and ResNet-50 (``r50``).
 
 The bench headline has sat at ~46-48% MFU for three rounds on the claim
 that "CIFAR-scale early convs under-fill the MXU". This tool replaces
@@ -17,7 +18,10 @@ the claim with numbers:
 - ``hlo``: dump the optimized HLO of the bench train step and print a
   fusion census (convolution count, fusion count, largest buffers).
 
-Usage: ``python tools/resnet_probe.py layers bn block`` (any subset).
+``r50`` runs the same per-conv harness over every distinct ResNet-50
+CIFAR conv shape at the fori-bench batch 256 (fwd+bwd only).
+
+Usage: ``python tools/resnet_probe.py layers bn block r50`` (any subset).
 """
 
 from __future__ import annotations
@@ -53,18 +57,20 @@ CONVS = [
 ]
 
 
-def conv_flops(ci, co, h, w, k, stride):
-    """Forward matmul FLOPs (2/MAC) of a SAME conv at batch B."""
+def conv_flops(ci, co, h, w, k, stride, batch):
+    """Forward matmul FLOPs (2/MAC) of a SAME conv."""
     ho, wo = (h + stride - 1) // stride, (w + stride - 1) // stride
-    return 2.0 * B * ho * wo * k * k * ci * co
+    return 2.0 * batch * ho * wo * k * k * ci * co
 
 
-def run_layers(peak):
-    print(f"== per-conv timing, batch {B}, bf16, peak {peak/1e12:.0f} TF/s")
+def run_layers(peak, batch=None, convs=None, fwd_too=True):
+    batch = batch or B
+    convs = convs if convs is not None else CONVS
+    print(f"== per-conv timing, batch {batch}, bf16, peak {peak/1e12:.0f} TF/s")
     key = jax.random.PRNGKey(0)
     total_fwd_t = total_fb_t = total_fwd_f = 0.0
-    for name, ci, co, h, w, k, stride, count in CONVS:
-        x = jax.random.normal(key, (B, h, w, ci), jnp.bfloat16)
+    for name, ci, co, h, w, k, stride, count in convs:
+        x = jax.random.normal(key, (batch, h, w, ci), jnp.bfloat16)
         wgt = jax.random.normal(key, (k, k, ci, co), jnp.bfloat16) * 0.05
         dn = jax.lax.conv_dimension_numbers(
             x.shape, wgt.shape, ("NHWC", "HWIO", "NHWC")
@@ -82,26 +88,30 @@ def run_layers(peak):
             y, pull = jax.vjp(conv, x, wgt)
             return pull(y)  # dX and dW with dY = y (shape-right cotangent)
 
-        f = conv_flops(ci, co, h, w, k, stride)
+        f = conv_flops(ci, co, h, w, k, stride, batch)
         # Sub-ms kernels: long fori windows so relay jitter differences out.
-        t_fwd = time_fn(f"{name} fwd", conv, x, wgt, iters_lo=24, iters_hi=96)
+        line = f"   {name:26s} x{count}:"
+        if fwd_too:
+            t_fwd = time_fn(f"{name} fwd", conv, x, wgt, iters_lo=24, iters_hi=96)
+            line += f" fwd {f/1e9:6.1f} GF {f/t_fwd/peak*100:5.1f}% |"
+            total_fwd_t += count * t_fwd
         t_fb = time_fn(f"{name} fwd+bwd", fb, x, wgt, iters_lo=24, iters_hi=96)
-        eff_f = f / t_fwd / peak
         # fwd+bwd = 3x fwd FLOPs (dX + dW each equal the fwd contraction)
-        eff_fb = 3 * f / t_fb / peak
-        print(
-            f"   {name:26s} x{count}: fwd {f/1e9:6.1f} GF {eff_f*100:5.1f}%"
-            f" | fwd+bwd {eff_fb*100:5.1f}% of peak"
-        )
-        total_fwd_t += count * t_fwd
+        print(line + f" fwd+bwd {3*f/t_fb/peak*100:5.1f}% of peak")
         total_fb_t += count * t_fb
         total_fwd_f += count * f
-    print(
-        f"   SUM convs: fwd {total_fwd_t*1e3:.2f} ms"
-        f" ({total_fwd_f/total_fwd_t/peak*100:.1f}% of peak),"
-        f" fwd+bwd {total_fb_t*1e3:.2f} ms"
-        f" ({3*total_fwd_f/total_fb_t/peak*100:.1f}% of peak)"
-    )
+    if fwd_too:
+        print(
+            f"   SUM convs: fwd {total_fwd_t*1e3:.2f} ms"
+            f" ({total_fwd_f/total_fwd_t/peak*100:.1f}% of peak),"
+            f" fwd+bwd {total_fb_t*1e3:.2f} ms"
+            f" ({3*total_fwd_f/total_fb_t/peak*100:.1f}% of peak)"
+        )
+    else:
+        print(
+            f"   SUM convs fwd+bwd {total_fb_t*1e3:.2f} ms"
+            f" ({3*total_fwd_f/total_fb_t/peak*100:.1f}% of peak)"
+        )
 
 
 def run_bn(peak):
@@ -175,6 +185,44 @@ def run_hlo():
     print(f"census: {convs} convolutions, {fusions} fusions, {customs} custom-calls")
 
 
+# ResNet-50 CIFAR: EVERY distinct conv shape at the fori-bench batch 256
+# (name, C_in, C_out, H, W, k, stride, count/fwd), from models/resnet.py
+# ResNet(stage_sizes=(3,4,6,3), block="bottleneck"): block 0 of each
+# stage reduces from the previous stage's width (and carries the stride
+# and the 1x1 projection); blocks 1+ reduce from 4*mid.
+R50_B = 256
+R50_CONVS = [
+    ("stem 3->64 @32", 3, 64, 32, 32, 3, 1, 1),
+    ("s1 1x1 64->64 @32", 64, 64, 32, 32, 1, 1, 1),
+    ("s1 1x1 256->64 @32", 256, 64, 32, 32, 1, 1, 2),
+    ("s1 3x3 64->64 @32", 64, 64, 32, 32, 3, 1, 3),
+    ("s1 1x1 64->256 @32 (+proj)", 64, 256, 32, 32, 1, 1, 4),
+    ("s2 1x1 256->128 @32", 256, 128, 32, 32, 1, 1, 1),
+    ("s2 1x1 512->128 @16", 512, 128, 16, 16, 1, 1, 3),
+    ("s2 3x3 128->128 @32/s2", 128, 128, 32, 32, 3, 2, 1),
+    ("s2 3x3 128->128 @16", 128, 128, 16, 16, 3, 1, 3),
+    ("s2 1x1 128->512 @16", 128, 512, 16, 16, 1, 1, 4),
+    ("s2 proj 256->512 @32/s2", 256, 512, 32, 32, 1, 2, 1),
+    ("s3 1x1 512->256 @16", 512, 256, 16, 16, 1, 1, 1),
+    ("s3 1x1 1024->256 @8", 1024, 256, 8, 8, 1, 1, 5),
+    ("s3 3x3 256->256 @16/s2", 256, 256, 16, 16, 3, 2, 1),
+    ("s3 3x3 256->256 @8", 256, 256, 8, 8, 3, 1, 5),
+    ("s3 1x1 256->1024 @8", 256, 1024, 8, 8, 1, 1, 6),
+    ("s3 proj 512->1024 @16/s2", 512, 1024, 16, 16, 1, 2, 1),
+    ("s4 1x1 1024->512 @8", 1024, 512, 8, 8, 1, 1, 1),
+    ("s4 1x1 2048->512 @4", 2048, 512, 4, 4, 1, 1, 2),
+    ("s4 3x3 512->512 @8/s2", 512, 512, 8, 8, 3, 2, 1),
+    ("s4 3x3 512->512 @4", 512, 512, 4, 4, 3, 1, 2),
+    ("s4 1x1 512->2048 @4", 512, 2048, 4, 4, 1, 1, 3),
+    ("s4 proj 1024->2048 @8/s2", 1024, 2048, 8, 8, 1, 2, 1),
+]
+
+
+def run_r50(peak):
+    print("== ResNet-50 per-conv timing (shared harness, fwd+bwd only)")
+    run_layers(peak, batch=R50_B, convs=R50_CONVS, fwd_too=False)
+
+
 def main():
     which = set(sys.argv[1:]) or {"layers"}
     peak = _peak_flops(jax.devices()[0]) or 197e12
@@ -186,6 +234,8 @@ def main():
         run_bn(peak)
     if "block" in which:
         run_block(peak)
+    if "r50" in which:
+        run_r50(peak)
 
 
 if __name__ == "__main__":
