@@ -170,13 +170,6 @@ def build_engine(args, devices):
             "(token-parallel head); tp/fsdp/pp shard or relocate the "
             "head itself"
         )
-    if getattr(args, "fused_ln", False) and args.moe_experts:
-        # MoE trunks keep the unfused path — silently no-opping would
-        # mislabel A/B runs (TransformerLM/Block raise too).
-        raise ValueError(
-            "--fused_ln is not supported with MoE (--moe_experts); the "
-            "flag would silently no-op"
-        )
     scores = getattr(args, "fused_xent_scores", False)
     lean = getattr(args, "fused_xent_lean", False)
     if (scores or lean) and not args.fused_xent:

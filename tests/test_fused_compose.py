@@ -9,9 +9,10 @@ Load-bearing properties (VERDICT r4 item 1):
 - ``fused_ln`` threads through the CP trunk (TransformerLM) and the
   pipeline stage (TransformerBlock's ln2-junction fusion) with identical
   math to the unfused junctions;
-- the silent-no-op traps are closed: fused_ln + MoE raises at model
-  construction, save_scores without fused_xent raises at engine
-  construction.
+- ``fused_ln`` + MoE is the same function as the unfused MoE trunk
+  (the junction kernel fuses the residual ADD, not the FFN branch; aux
+  state threads through the deferred trunk); save_scores without
+  fused_xent raises at engine construction.
 
 On CPU both kernels dispatch to reference math, so these tests pin the
 PLUMBING and the sharded-mean structure; kernel numerics are pinned
@@ -225,11 +226,44 @@ def test_task5_accepts_fused_flags_multichip():
 # ------------------------------------------------------------------ guards
 
 
-def test_fused_ln_moe_raises():
-    with pytest.raises(ValueError, match="fused_ln"):
-        _lm(fused_ln=True, moe_experts=2)
-    with pytest.raises(ValueError, match="fused_ln"):
-        TransformerBlock(DIM, HEADS, fused_ln=True, moe_experts=2)
+def test_fused_ln_moe_matches_unfused():
+    """fused_ln composes with MoE: the deferred trunk routes the FFN
+    branch through the MoE layer and threads the aux-loss state, so
+    values, gradients (router included), AND the aux loss match the
+    unfused MoE trunk."""
+    kw = dict(moe_experts=2, moe_capacity_factor=8.0)
+    lm_u = _lm(**kw)
+    lm_f = _lm(fused_ln=True, **kw)
+    params, state = lm_u.init(seed_key(2))
+    toks = jnp.asarray(_tokens()[:, :-1])
+
+    def loss(lm, p):
+        logits, new_state = lm.apply(p, state, toks, train=True)
+        from tpudml.train import collect_aux_losses
+        return jnp.sum(jnp.sin(logits)) * 1e-2 + \
+            jnp.sum(logits**2) * 1e-3 + collect_aux_losses(new_state)
+
+    lu, gu = jax.value_and_grad(lambda p: loss(lm_u, p))(params)
+    lf, gf = jax.value_and_grad(lambda p: loss(lm_f, p))(params)
+    np.testing.assert_allclose(float(lf), float(lu), rtol=1e-5)
+    _assert_tree_close(gf, gu)
+
+    # The pipeline-stage form too (block-level ln2 fusion + MoE).
+    block_u = TransformerBlock(DIM, HEADS, **kw)
+    block_f = TransformerBlock(DIM, HEADS, fused_ln=True, **kw)
+    bp, bs = block_u.init(seed_key(3))
+    x = jnp.asarray(
+        np.random.default_rng(5).normal(size=(B, T, DIM)).astype(np.float32)
+    )
+
+    def bloss(block, p):
+        out, st = block.apply(p, bs, x)
+        return jnp.sum(out * jnp.cos(x)) + st["moe"]["aux_loss"]
+
+    blu, bgu = jax.value_and_grad(lambda p: bloss(block_u, p))(bp)
+    blf, bgf = jax.value_and_grad(lambda p: bloss(block_f, p))(bp)
+    np.testing.assert_allclose(float(blf), float(blu), rtol=1e-6)
+    _assert_tree_close(bgf, bgu)
 
 
 def test_save_scores_requires_fused_xent():

@@ -143,18 +143,11 @@ class TransformerBlock(Module):
     # x → x contract (the closing residual add stays unfused, so the
     # stage payload is still one tensor), fusing 1 of its 2 junctions —
     # the LM's ``fused_ln`` trunk fuses 2L of 2L+1 by deferring adds
-    # across block boundaries, which a pipeline cut cannot do. Dense FFN
-    # only (construction raises with MoE).
+    # across block boundaries, which a pipeline cut cannot do. The FFN
+    # branch may be the dense MLP or the MoE layer — the junction kernel
+    # fuses the residual ADD, not the branch.
     fused_ln: bool = False
     dtype: Any = jnp.float32
-
-    def __post_init__(self):
-        if self.fused_ln and self.moe_experts:
-            raise ValueError(
-                "fused_ln=True is not supported with moe_experts (the MoE "
-                "trunk keeps the unfused junctions); a silent no-op would "
-                "mislabel A/B comparisons"
-            )
 
     def _parts(self):
         d = self.embed_dim
@@ -218,9 +211,20 @@ class TransformerBlock(Module):
             {}, h, train=True, rng=jax.random.fold_in(rng, salt)
         )
 
+    def _ffn_branch(self, parts, params, state, y, train):
+        """Post-norm FFN branch — dense MLP or MoE. The ONE site that
+        encodes the branch contract for every trunk form (block fused/
+        unfused, LM deferred); returns (h, per-block state update)."""
+        if self.moe_experts:
+            h, moe_state = parts["moe"].apply(
+                params["moe"], state.get("moe", {}), y, train=train
+            )
+            return h, {"moe": moe_state}
+        h = jax.nn.gelu(parts["fc1"](params["fc1"], y))
+        return parts["fc2"](params["fc2"], h), {}
+
     def apply(self, params, state, x, *, train=False, rng=None):
         parts = self._parts()
-        new_state = {}
         h = parts["ln1"](params["ln1"], x)
         h = parts["attn"](params["attn"], h)
         if self.fused_ln:
@@ -232,19 +236,11 @@ class TransformerBlock(Module):
                 params["ln2"]["scale"],
                 params["ln2"]["bias"],
             )
-            h = jax.nn.gelu(parts["fc1"](params["fc1"], y2))
-            h = parts["fc2"](params["fc2"], h)
+            h, new_state = self._ffn_branch(parts, params, state, y2, train)
             return s + self._drop(h, train, rng, 2), new_state
         x = x + self._drop(h, train, rng, 1)
         h = parts["ln2"](params["ln2"], x)
-        if self.moe_experts:
-            h, moe_state = parts["moe"].apply(
-                params["moe"], state.get("moe", {}), h, train=train
-            )
-            new_state["moe"] = moe_state
-        else:
-            h = jax.nn.gelu(parts["fc1"](params["fc1"], h))
-            h = parts["fc2"](params["fc2"], h)
+        h, new_state = self._ffn_branch(parts, params, state, h, train)
         return x + self._drop(h, train, rng, 2), new_state
 
 
@@ -358,9 +354,10 @@ class TransformerLM(Module):
     # residual-gradient merge folded in (round-3 ablation: the in-situ LN
     # cost is fusion structure, not arithmetic — BASELINE.md). Identical
     # math to the unfused path (the sum rounds to the stream dtype before
-    # the f32 statistics); dense-FFN blocks only (MoE keeps the unfused
-    # trunk). On non-TPU backends the op dispatches to reference math, so
-    # the flag is safe everywhere.
+    # the f32 statistics); the FFN branch may be dense or MoE (the kernel
+    # fuses the residual ADD, not the branch — MoE aux state threads
+    # through the deferred trunk). On non-TPU backends the op dispatches
+    # to reference math, so the flag is safe everywhere.
     fused_ln: bool = False
     # Mixed precision, ResNet-style: parameters stay in ``dtype`` (the f32
     # master copy the optimizer updates) and are cast per-apply to
@@ -373,16 +370,6 @@ class TransformerLM(Module):
     # jnp.float32: the legacy all-bf16 mode (dtype=bf16, compute_dtype
     # unset) must keep computing in bf16, not get upcast.
     compute_dtype: Any = None
-
-    def __post_init__(self):
-        if self.fused_ln and self.moe_experts:
-            # Mirror the task5 CLI guard for direct API users: silently
-            # falling back to the unfused trunk would mislabel A/B
-            # comparisons (the exact failure mode the guard exists for).
-            raise ValueError(
-                "fused_ln=True is not supported with moe_experts (MoE "
-                "trunks keep the unfused junctions); drop one of the two"
-            )
 
     def _block(self) -> TransformerBlock:
         return TransformerBlock(
@@ -468,12 +455,15 @@ class TransformerLM(Module):
                 new_state[f"block{i}"] = s
         return h, new_state
 
-    def _trunk_deferred(self, params, tokens, train, rng):
-        """Fused-junction trunk (``fused_ln=True``, dense FFN only): embed
-        → blocks with each residual add deferred into the next norm's
-        fused add+LN kernel. Returns ``(s, pend)`` — the residual stream
-        and the still-unadded final FFN branch — so the caller can close
-        the last junction inside the final-norm fusion too."""
+    def _trunk_deferred(self, params, state, tokens, train, rng):
+        """Fused-junction trunk (``fused_ln=True``): embed → blocks with
+        each residual add deferred into the next norm's fused add+LN
+        kernel. The FFN branch is the dense MLP or the MoE layer — the
+        junction kernel is FFN-agnostic (it fuses the residual ADD, not
+        the branch). Returns ``(s, pend, new_state)`` — the residual
+        stream, the still-unadded final FFN branch (so the caller can
+        close the last junction inside the final-norm fusion too), and
+        the threaded model state (MoE aux-loss slots)."""
         from tpudml.ops.layernorm_kernel import fused_add_layernorm
 
         embed_keys = ("tok_embed",) + (() if self.rope else ("pos_embed",))
@@ -481,6 +471,7 @@ class TransformerLM(Module):
         block = self._block()
         parts = block._parts()
         pend = None
+        new_state = {}
         for i in range(self.num_layers):
             p = params[f"block{i}"]
             brng = None if rng is None else jax.random.fold_in(rng, i)
@@ -497,31 +488,35 @@ class TransformerLM(Module):
                 p["ln2"]["scale"],
                 p["ln2"]["bias"],
             )
-            h = jax.nn.gelu(parts["fc1"](p["fc1"], y2))
-            pend = block._drop(parts["fc2"](p["fc2"], h), train, brng, 2)
-        return s, pend
+            h, st = block._ffn_branch(
+                parts, p, state.get(f"block{i}", {}), y2, train
+            )
+            if st:
+                new_state[f"block{i}"] = st
+            pend = block._drop(h, train, brng, 2)
+        return s, pend, new_state
 
-    def _features_deferred(self, params, tokens, train, rng):
+    def _features_deferred(self, params, state, tokens, train, rng):
         """Deferred trunk closed through the final norm: the last block's
         residual add fuses into ln_f."""
         from tpudml.ops.layernorm_kernel import fused_add_layernorm
 
-        s, pend = self._trunk_deferred(params, tokens, train, rng)
+        s, pend, new_state = self._trunk_deferred(params, state, tokens, train, rng)
         _, y = fused_add_layernorm(
             s, pend, params["ln_f"]["scale"], params["ln_f"]["bias"]
         )
-        return y
+        return y, new_state
 
     def _use_fused_ln(self):
         # num_layers=0 leaves no junction to fuse (pend would stay None).
-        return self.fused_ln and not self.moe_experts and self.num_layers > 0
+        return self.fused_ln and self.num_layers > 0
 
     def apply(self, params, state, tokens, *, train=False, rng=None):
         params = self._cast_params(params)
         if self._use_fused_ln():
-            y = self._features_deferred(params, tokens, train, rng)
+            y, new_state = self._features_deferred(params, state, tokens, train, rng)
             head = Dense(self.embed_dim, self.vocab_size, dtype=self.dtype)
-            return head(params["head"], y), state
+            return head(params["head"], y), new_state
         h, new_state = self._trunk(params, state, tokens, train, rng)
         logits = self._head()({k: params[k] for k in ("ln_f", "head")}, h)
         # Logits stay in compute dtype: softmax_cross_entropy computes its
@@ -538,7 +533,8 @@ class TransformerLM(Module):
         [B·T, V] logits."""
         params = self._cast_params(params)
         if self._use_fused_ln():
-            return self._features_deferred(params, tokens, train, rng), state
+            y, new_state = self._features_deferred(params, state, tokens, train, rng)
+            return y, new_state
         h, new_state = self._trunk(params, state, tokens, train, rng)
         h = LayerNorm(self.embed_dim, dtype=self.dtype)(params["ln_f"], h)
         return h, new_state
