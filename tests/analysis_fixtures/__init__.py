@@ -1,0 +1,3 @@
+# Fixture package for tests/test_analysis.py: seeded_violations.py must
+# trip every AST rule, clean.py none. Lives under tests/ so the repo-wide
+# analyzer run (tpudml/ tasks/ tools/) never sees the seeded violations.

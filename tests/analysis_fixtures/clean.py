@@ -1,0 +1,42 @@
+"""Clean twins of seeded_violations.py: no rule may fire on this module.
+
+Each function does the same job as its seeded counterpart using the
+compliant idiom the rule's fix hint prescribes.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def c201_host_control(x):
+    y = float(jnp.mean(x))  # materialized on host before branching
+    if y > 0:
+        x = x + 1.0
+    return jnp.where(jnp.mean(x) > 0, x, -x)  # traced branch, traced select
+
+
+def c202_key_split(shape):
+    key = jax.random.PRNGKey(0)
+    key, sub = jax.random.split(key)
+    a = jax.random.normal(sub, shape)
+    b = jax.random.uniform(key, shape)  # each key consumed exactly once
+    return a + b
+
+
+def c203_epoch_loop(loader, model):
+    seen = 0
+    for epoch in range(3):
+        loader.set_epoch(epoch)
+        for batch in loader:
+            seen += 1
+    return seen
+
+
+def c204_bracketed_timing(step, ts, batch):
+    t0 = time.time()
+    ts, metrics = step(ts, *batch)
+    jax.block_until_ready(metrics)
+    elapsed = time.time() - t0
+    return ts, batch[0].shape[0] / elapsed

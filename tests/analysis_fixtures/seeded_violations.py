@@ -1,0 +1,42 @@
+"""Seeded violations: every A2xx rule must fire on this module.
+
+Nothing here is executed — the AST pass reads source only. Each function
+is the minimal natural form of the hazard its rule describes.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def a201_branch_on_traced(x):
+    y = jnp.mean(x)
+    if y > 0:  # A201: traced value in Python control flow
+        x = x + 1.0
+    for v in jnp.arange(4):  # A201: loop unrolls into the program
+        x = x + v
+    return x
+
+
+def a202_key_reuse(shape):
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, shape)
+    b = jax.random.uniform(key, shape)  # A202: same key, both draws related
+    return a + b
+
+
+def a203_epoch_loop(loader, model):
+    seen = 0
+    for epoch in range(3):  # A203: no loader.set_epoch(epoch)
+        for batch in loader:
+            seen += 1
+    return seen
+
+
+def a204_unbracketed_timing(step, ts, batch):
+    t0 = time.time()
+    ts, metrics = step(ts, *batch)
+    elapsed = time.time() - t0  # A204: dispatch returned, device still busy
+    rate = jnp.asarray(batch[0].shape[0] / elapsed)
+    return ts, rate

@@ -25,14 +25,23 @@ _provision_cpu_mesh(8)
 # identically across runs. The first (cold) run pays full compile; repeat
 # runs — the signal loop a developer actually sits in — reuse cached
 # executables. Numbers in pytest.ini.
+#
+# OPT-IN (TPUDML_TEST_CACHE=1): on jax 0.4.37/jaxlib 0.4.36 the CPU
+# deserialization path of cached executables corrupts the heap — the
+# suite dies mid-run with munmap_chunk()/segfaults at random points
+# after a few cache hits (reproducer: pytest tests/test_api.py
+# tests/test_checkpoint.py with the cache on). Correct-but-slow beats
+# fast-but-crashing as the default; flip it back on when the pinned
+# jaxlib moves past the bug.
 import jax  # noqa: E402
 
-jax.config.update(
-    "jax_compilation_cache_dir",
-    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                 ".jax_test_cache"),
-)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+if os.environ.get("TPUDML_TEST_CACHE"):
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     ".jax_test_cache"),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -266,6 +266,25 @@ def test_fused_ln_moe_matches_unfused():
     _assert_tree_close(bgf, bgu)
 
 
+def test_fused_ln_moe_matches_unfused_under_ep():
+    """fused_ln + MoE under expert parallelism: the shard_map EP engine
+    trains the SAME trajectory fused vs unfused — the junction kernel
+    fuses the residual add, not the FFN branch, so expert dispatch across
+    the mesh and the psum'd aux loss are untouched (README's 'including
+    under expert parallelism' claim, pinned on the CPU mesh)."""
+    from tpudml.parallel.ep import ExpertParallel
+
+    mesh = make_mesh(MeshConfig({"expert": 2}), jax.devices()[:2])
+    kw = dict(moe_experts=2, moe_capacity_factor=8.0, moe_axis="expert")
+    opt = lambda: make_optimizer("adam", 1e-2)
+    ts_u, loss_u = _run_steps(ExpertParallel(_lm(**kw), opt(), mesh))
+    ts_f, loss_f = _run_steps(
+        ExpertParallel(_lm(fused_ln=True, **kw), opt(), mesh)
+    )
+    np.testing.assert_allclose(loss_f, loss_u, rtol=1e-5)
+    _assert_tree_close(ts_f.params, ts_u.params)
+
+
 def test_save_scores_requires_fused_xent():
     mesh = make_mesh(MeshConfig({"data": 2}), jax.devices()[:2])
     opt = make_optimizer("adam", 1e-3)

@@ -69,6 +69,28 @@ def test_batchnorm_updates_state_in_train():
     assert same_state is state
 
 
+def test_batchnorm_f32_large_mean_recovers_variance():
+    """Two-pass variance for f32 inputs: at mean 1e4 with std 0.1 the
+    single-pass E[x²]−m² form loses every variance bit to f32
+    cancellation (clamp → var=0, output blown up by rsqrt(eps)); the
+    two-pass E[(x−m)²] recovers it. bf16 inputs keep the cheaper
+    single-pass form — their quantization floor is above the
+    cancellation error anyway."""
+    bn = BatchNorm(16)
+    params, state = bn.init(jax.random.key(0))
+    noise = jax.random.normal(jax.random.key(1), (512, 16))
+    x = 1e4 + 0.1 * noise
+    y, new_state = bn.apply(params, state, x, train=True)
+    # momentum 0.9 folds 0.1 of the batch var (~0.01) into state var 1.0
+    batch_var = (np.asarray(new_state["var"]) - 0.9) / 0.1
+    np.testing.assert_allclose(batch_var, 0.01, rtol=0.2)
+    # normalized output ≈ the (unit-ish) noise, not rsqrt(eps)-scaled
+    assert float(jnp.std(y)) < 3.0
+    # bf16 path still runs and stays finite through its single-pass form
+    y16, _ = bn.apply(params, state, x.astype(jnp.bfloat16), train=True)
+    assert np.all(np.isfinite(np.asarray(y16, dtype=np.float32)))
+
+
 def test_dropout_train_vs_eval():
     d = Dropout(0.5)
     x = jnp.ones((100, 100))

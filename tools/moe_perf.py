@@ -76,8 +76,15 @@ def main():
     sec_dense = record(TransformerLM(**base), "dense FFN (baseline)", x, y, on_tpu)
     for e in (4, 8):
         for cap in (1.25, 2.0):
+            # Keep-rate/utilization depend only on (E, cap), not on the
+            # dispatch implementation — print them once per config.
             keep, util = capacity_probe(
                 base["embed_dim"], e, cap, x.shape[0] * x.shape[1]
+            )
+            print(
+                f"MoE E={e} top-1 cap={cap}: token keep-rate {keep:.1%}, "
+                f"slot utilization {util:.1%} (router at init)",
+                flush=True,
             )
             for dispatch in ("einsum", "gather"):
                 sec = record(
@@ -89,8 +96,7 @@ def main():
                 )
                 print(
                     f"    -> dispatch overhead {1e3*(sec - sec_dense):+.2f} ms/step "
-                    f"({sec/sec_dense:.2f}x dense); token keep-rate {keep:.1%}, "
-                    f"slot utilization {util:.1%} (router at init)",
+                    f"({sec/sec_dense:.2f}x dense)",
                     flush=True,
                 )
 

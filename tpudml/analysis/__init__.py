@@ -1,0 +1,51 @@
+"""Static-analysis suite: pre-flight lint for TPU distributed training.
+
+Two complementary passes over the codebase (docs/ANALYSIS.md has the
+full rule catalogue):
+
+- the **jaxpr pass** (``jaxpr_pass``, rules J1xx) traces the real train
+  steps — the engines in ``tpudml/parallel/`` wired to tiny models by
+  ``entrypoints`` — with abstract inputs on CPU and walks the resulting
+  ClosedJaxpr for hazards that otherwise only fail on a multi-host
+  slice: unbound collective axes, branch-divergent collectives, host
+  callbacks, stray bf16→f32 upcasts, closure-captured megabyte
+  constants, undonated training state;
+- the **AST pass** (``ast_pass``, rules A2xx) lints the source for
+  hazards tracing cannot see: Python control flow over traced values,
+  PRNG key reuse, epoch loops missing ``set_epoch``, host-clock timing
+  without ``block_until_ready``.
+
+Run it as ``python -m tpudml.analysis`` (``--strict`` for CI, paired
+with the committed ``analysis/allowlist.toml``).
+"""
+
+from tpudml.analysis.allowlist import load_allowlist, split_allowed
+from tpudml.analysis.ast_pass import analyze_file, analyze_source, analyze_tree
+from tpudml.analysis.entrypoints import (
+    ENTRYPOINTS,
+    analyze_entrypoint,
+    analyze_entrypoints,
+)
+from tpudml.analysis.findings import RULES, Finding, sort_findings
+from tpudml.analysis.jaxpr_pass import (
+    analyze_callable,
+    analyze_closed_jaxpr,
+    donation_findings,
+)
+
+__all__ = [
+    "RULES",
+    "Finding",
+    "ENTRYPOINTS",
+    "analyze_callable",
+    "analyze_closed_jaxpr",
+    "analyze_entrypoint",
+    "analyze_entrypoints",
+    "analyze_file",
+    "analyze_source",
+    "analyze_tree",
+    "donation_findings",
+    "load_allowlist",
+    "sort_findings",
+    "split_allowed",
+]

@@ -1,0 +1,121 @@
+"""CLI: ``python -m tpudml.analysis [--strict] [...]``.
+
+Report-only by default; ``--strict`` (the CI mode) exits non-zero when
+any finding is not covered by the committed allowlist. The jaxpr pass
+needs >= 2 visible devices, so an 8-device CPU host platform is
+provisioned before the first backend touch — same dance as
+``tests/conftest.py`` — which makes the tool runnable on any dev box
+with ``JAX_PLATFORMS=cpu``, no TPU required.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _provision_devices() -> None:
+    """Force an 8-device CPU platform before jax initializes a backend."""
+    try:
+        # Repo harness helper (handles site hooks that latch JAX_PLATFORMS).
+        from __graft_entry__ import _provision_cpu_mesh
+
+        _provision_cpu_mesh(8)
+        return
+    except Exception:
+        pass
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tpudml.analysis",
+        description="Static pre-flight analysis for TPU distributed "
+                    "training hazards (jaxpr + AST passes).",
+    )
+    parser.add_argument("--strict", action="store_true",
+                        help="exit 1 on any finding not in the allowlist")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit findings as JSON on stdout")
+    parser.add_argument("--entrypoints", default=None, metavar="A,B",
+                        help="comma-separated jaxpr entrypoints "
+                             "(default: all; see --list-rules)")
+    parser.add_argument("--paths", nargs="*", default=None,
+                        help="roots for the AST pass "
+                             "(default: tpudml tasks tools)")
+    parser.add_argument("--allowlist", default=None, metavar="TOML",
+                        help="allowlist path (default: "
+                             "analysis/allowlist.toml)")
+    parser.add_argument("--skip-jaxpr", action="store_true",
+                        help="AST pass only (no tracing, no jax import)")
+    parser.add_argument("--skip-ast", action="store_true",
+                        help="jaxpr pass only")
+    parser.add_argument("--show-allowed", action="store_true",
+                        help="also print findings the allowlist suppressed")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalogue and entrypoints")
+    args = parser.parse_args(argv)
+
+    from tpudml.analysis.findings import RULES, sort_findings
+
+    if args.list_rules:
+        from tpudml.analysis.entrypoints import ENTRYPOINTS
+
+        for rule, (sev, desc) in RULES.items():
+            print(f"{rule}  {sev:5s}  {desc}")
+        print("\nentrypoints:", ", ".join(ENTRYPOINTS))
+        return 0
+
+    findings = []
+    if not args.skip_ast:
+        from tpudml.analysis.ast_pass import analyze_tree
+
+        roots = args.paths or [r for r in ("tpudml", "tasks", "tools")
+                               if os.path.isdir(r)]
+        findings.extend(analyze_tree(roots))
+    if not args.skip_jaxpr:
+        _provision_devices()
+        from tpudml.analysis.entrypoints import ENTRYPOINTS, analyze_entrypoints
+
+        names = None
+        if args.entrypoints:
+            names = [n.strip() for n in args.entrypoints.split(",") if n.strip()]
+            unknown = [n for n in names if n not in ENTRYPOINTS]
+            if unknown:
+                parser.error(f"unknown entrypoints {unknown}; "
+                             f"known: {', '.join(ENTRYPOINTS)}")
+        findings.extend(analyze_entrypoints(names))
+
+    from tpudml.analysis.allowlist import load_allowlist, split_allowed
+
+    entries = load_allowlist(args.allowlist)
+    active, allowed = split_allowed(sort_findings(findings), entries)
+
+    if args.as_json:
+        print(json.dumps({
+            "active": [f.__dict__ | {"severity": f.severity} for f in active],
+            "allowed": [f.__dict__ | {"severity": f.severity} for f in allowed],
+        }, indent=2))
+    else:
+        for f in active:
+            print(f.format())
+        if args.show_allowed and allowed:
+            print(f"\n-- allowlisted ({len(allowed)}) --")
+            for f in allowed:
+                print(f.format())
+        print(f"\n{len(active)} finding(s), {len(allowed)} allowlisted "
+              f"({len(entries)} allowlist entr{'y' if len(entries) == 1 else 'ies'})")
+
+    if args.strict and active:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,84 @@
+"""Committed allowlist: triaged true-but-accepted findings.
+
+``analysis/allowlist.toml`` (repo root) holds ``[[allow]]`` tables:
+
+    [[allow]]
+    rule = "J104"                  # required
+    path = "tpudml/nn/layers.py"   # fnmatch glob against the finding's
+                                   # file (or "<entrypoint>" pseudo-path)
+    reason = "LN stats accumulate in f32 by design"   # required
+    # line = 123                   # optional: pin to an exact line
+
+Matching is on (rule, path[, line]) — not message text, which changes
+with shapes. An entry with no ``path`` matches the rule everywhere; use
+that sparingly. ``--strict`` fails on any finding NOT matched here, so
+the workflow is: run the analyzer, fix what is fixable, and commit an
+entry with a one-line ``reason`` for what is accepted. The reason field
+is mandatory precisely so the allowlist stays reviewable.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import os
+from dataclasses import dataclass
+
+from tpudml.analysis.findings import Finding
+
+DEFAULT_PATH = os.path.join("analysis", "allowlist.toml")
+
+
+@dataclass(frozen=True)
+class AllowEntry:
+    rule: str
+    path: str = "*"
+    line: int = 0  # 0 = any line
+    reason: str = ""
+
+    def matches(self, f: Finding) -> bool:
+        if f.rule != self.rule:
+            return False
+        loc = f.file or (f"<{f.entrypoint}>" if f.entrypoint else "")
+        if not fnmatch.fnmatch(loc, self.path):
+            return False
+        return self.line == 0 or self.line == f.line
+
+
+def _load_toml(path: str) -> dict:
+    try:
+        import tomllib  # py311+
+    except ModuleNotFoundError:
+        import tomli as tomllib  # py310: vendored with the toolchain
+    with open(path, "rb") as fh:
+        return tomllib.load(fh)
+
+
+def load_allowlist(path: str | None = None) -> list[AllowEntry]:
+    path = path or DEFAULT_PATH
+    if not os.path.exists(path):
+        return []
+    data = _load_toml(path)
+    entries: list[AllowEntry] = []
+    for i, raw in enumerate(data.get("allow", [])):
+        if "rule" not in raw or "reason" not in raw:
+            raise ValueError(
+                f"{path}: [[allow]] entry #{i + 1} needs 'rule' and "
+                f"'reason' keys (got {sorted(raw)})")
+        entries.append(AllowEntry(
+            rule=str(raw["rule"]),
+            path=str(raw.get("path", "*")),
+            line=int(raw.get("line", 0)),
+            reason=str(raw["reason"]),
+        ))
+    return entries
+
+
+def split_allowed(
+    findings: list[Finding], entries: list[AllowEntry],
+) -> tuple[list[Finding], list[Finding]]:
+    """(active, allowed) partition of findings against the allowlist."""
+    active: list[Finding] = []
+    allowed: list[Finding] = []
+    for f in findings:
+        (allowed if any(e.matches(f) for e in entries) else active).append(f)
+    return active, allowed

@@ -1,0 +1,300 @@
+"""Pass 2 — AST lint for source-level hazards the tracer cannot see.
+
+The jaxpr pass only sees what survives tracing; these four hazard
+classes disappear (or worse, silently bake in) before a jaxpr exists:
+
+- A201  Python ``for``/``if`` over a traced value: under jit this either
+        raises a ConcretizationTypeError at runtime or — for ``for`` over
+        a concrete-shaped array — silently unrolls the loop into the
+        program;
+- A202  a PRNG key consumed by two sampler calls without an intervening
+        ``split``/reassignment: both draws are identical;
+- A203  an epoch loop that re-iterates a sharded loader without calling
+        ``set_epoch``: every epoch replays epoch-0's shuffle order;
+- A204  host-clock deltas (``time.time``/``perf_counter``) around device
+        work with no ``block_until_ready`` in the function: the clock
+        measures dispatch, not execution.
+
+All checks are deliberately name-based heuristics scoped to one function
+at a time (module top-level counts as a function for scripts in
+``tools/``). They are tuned for this repo's idiom — low false-positive
+rate beats completeness, and anything accepted lands in the allowlist.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable
+
+from tpudml.analysis.findings import Finding
+
+#: jax.random samplers that consume (fold in) their key argument.
+_SAMPLERS = frozenset({
+    "normal", "uniform", "bernoulli", "randint", "permutation", "choice",
+    "categorical", "gumbel", "truncated_normal", "bits", "exponential",
+    "laplace", "beta", "gamma", "dirichlet", "poisson", "shuffle",
+})
+#: jax.random functions that derive fresh keys (uses are fine).
+_KEY_DERIVERS = frozenset({"split", "fold_in", "clone", "key_data", "wrap_key_data"})
+_KEY_MAKERS = frozenset({"PRNGKey", "key"})
+
+_CLOCKS = frozenset({"time", "perf_counter", "monotonic", "process_time"})
+
+
+def _attr_chain(node: ast.AST) -> str:
+    """Dotted name of a Name/Attribute chain ('' when not a plain chain)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_traced_call(node: ast.AST) -> bool:
+    """Call whose result is a traced array: jnp.*/lax.*/jax.numpy.* etc."""
+    if not isinstance(node, ast.Call):
+        return False
+    chain = _attr_chain(node.func)
+    head = chain.split(".")[0] if chain else ""
+    return head in ("jnp", "lax") or chain.startswith(("jax.numpy", "jax.lax"))
+
+
+def _mentions_jax(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and node.id in ("jax", "jnp", "lax"):
+            return True
+    return False
+
+
+class _FunctionLinter:
+    """Runs every rule over one function-like scope."""
+
+    def __init__(self, path: str, scope_body: list[ast.stmt]):
+        self.path = path
+        self.body = scope_body
+        self.findings: list[Finding] = []
+
+    def _emit(self, rule: str, message: str, node: ast.AST) -> None:
+        self.findings.append(Finding(
+            rule, message, file=self.path, line=getattr(node, "lineno", 0)))
+
+    # -- A201 ---------------------------------------------------------
+    def check_traced_control_flow(self) -> None:
+        traced: set[str] = set()
+        for node in self._ordered_nodes():
+            if isinstance(node, ast.Assign) and _is_traced_call(node.value):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        traced.add(tgt.id)
+            elif isinstance(node, ast.Assign):
+                # any other reassignment launders the name (float(x), .item())
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        traced.discard(tgt.id)
+            elif isinstance(node, (ast.If, ast.While)):
+                expr = node.test
+                if self._expr_is_traced(expr, traced):
+                    self._emit(
+                        "A201",
+                        "branch condition is a traced value — under jit "
+                        "this raises ConcretizationTypeError",
+                        node)
+            elif isinstance(node, ast.For):
+                if self._expr_is_traced(node.iter, traced):
+                    self._emit(
+                        "A201",
+                        "Python for-loop over a traced value — the loop "
+                        "unrolls into the program (or fails to trace)",
+                        node)
+
+    def _expr_is_traced(self, expr: ast.AST, traced: set[str]) -> bool:
+        if _is_traced_call(expr):
+            return True
+        if isinstance(expr, ast.Name) and expr.id in traced:
+            return True
+        if isinstance(expr, ast.Compare):
+            return any(self._expr_is_traced(e, traced)
+                       for e in [expr.left, *expr.comparators])
+        if isinstance(expr, ast.BoolOp):
+            return any(self._expr_is_traced(e, traced) for e in expr.values)
+        if isinstance(expr, ast.UnaryOp):
+            return self._expr_is_traced(expr.operand, traced)
+        return False
+
+    # -- A202 ---------------------------------------------------------
+    def check_key_reuse(self) -> None:
+        consumed: dict[str, int] = {}  # key name -> line of first consume
+        for node in self._ordered_nodes():
+            if isinstance(node, ast.Assign):
+                for tgt in self._assign_names(node):
+                    consumed.pop(tgt, None)  # reassignment refreshes the key
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _attr_chain(node.func)
+            leaf = chain.rsplit(".", 1)[-1] if chain else ""
+            if "random" not in chain or leaf not in _SAMPLERS:
+                continue
+            if leaf in _KEY_DERIVERS or leaf in _KEY_MAKERS:
+                continue
+            for arg in node.args[:1]:  # key is positionally first
+                if isinstance(arg, ast.Name):
+                    if arg.id in consumed:
+                        self._emit(
+                            "A202",
+                            f"key '{arg.id}' already consumed by a sampler "
+                            f"at line {consumed[arg.id]} — both draws are "
+                            f"identical; split first",
+                            node)
+                    else:
+                        consumed[arg.id] = node.lineno
+
+    @staticmethod
+    def _assign_names(node: ast.Assign) -> list[str]:
+        names: list[str] = []
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                names.append(tgt.id)
+            elif isinstance(tgt, (ast.Tuple, ast.List)):
+                names.extend(e.id for e in tgt.elts if isinstance(e, ast.Name))
+        return names
+
+    # -- A203 ---------------------------------------------------------
+    def check_set_epoch(self) -> None:
+        for node in self._ordered_nodes():
+            if not isinstance(node, ast.For):
+                continue
+            tgt = node.target
+            is_epoch_loop = (isinstance(tgt, ast.Name)
+                             and "epoch" in tgt.id.lower())
+            if not is_epoch_loop:
+                continue
+            iterates_loader = False
+            calls_set_epoch = False
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.For) and sub is not node:
+                    name = ""
+                    if isinstance(sub.iter, ast.Name):
+                        name = sub.iter.id
+                    elif isinstance(sub.iter, ast.Call):
+                        name = _attr_chain(sub.iter.func)
+                    if "loader" in name.lower() or "dataloader" in name.lower():
+                        iterates_loader = True
+                if (isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr == "set_epoch"):
+                    calls_set_epoch = True
+            if iterates_loader and not calls_set_epoch:
+                self._emit(
+                    "A203",
+                    "epoch loop iterates a loader without set_epoch(epoch) "
+                    "— every epoch replays the same shuffle order",
+                    node)
+
+    # -- A204 ---------------------------------------------------------
+    def check_timing(self) -> None:
+        clock_calls: list[ast.Call] = []
+        has_block = False
+        for node in self._ordered_nodes():
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _attr_chain(node.func)
+            leaf = chain.rsplit(".", 1)[-1] if chain else ""
+            if leaf in _CLOCKS and chain.split(".")[0] in ("time", leaf):
+                clock_calls.append(node)
+            if "block_until_ready" in chain or leaf == "block_until_ready":
+                has_block = True
+        if len(clock_calls) >= 2 and not has_block:
+            self._emit(
+                "A204",
+                "host-clock delta with no block_until_ready in scope — "
+                "async dispatch means this times the Python overhead, not "
+                "the device work",
+                clock_calls[1])
+
+    # ------------------------------------------------------------------
+    def _ordered_nodes(self) -> Iterable[ast.AST]:
+        """Every node in this scope in source order, NOT descending into
+        nested function/class definitions (they get their own linter)."""
+        out: list[ast.AST] = []
+
+        def visit(n: ast.AST) -> None:
+            for child in ast.iter_child_nodes(n):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.ClassDef, ast.Lambda)):
+                    continue
+                out.append(child)
+                visit(child)
+
+        for stmt in self.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            out.append(stmt)
+            visit(stmt)
+        out.sort(key=lambda n: (getattr(n, "lineno", 0),
+                                getattr(n, "col_offset", 0)))
+        return out
+
+    def run(self, jax_in_scope: bool) -> list[Finding]:
+        self.check_traced_control_flow()
+        self.check_key_reuse()
+        self.check_set_epoch()
+        if jax_in_scope:
+            self.check_timing()
+        return self.findings
+
+
+def _scopes(tree: ast.Module):
+    """Yield (body, node_for_jax_check) for the module and each def."""
+    yield tree.body, tree
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node.body, node
+
+
+def analyze_source(source: str, path: str) -> list[Finding]:
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding("J100", f"file failed to parse: {e}", file=path,
+                        line=e.lineno or 0)]
+    findings: list[Finding] = []
+    for body, scope_node in _scopes(tree):
+        linter = _FunctionLinter(path, body)
+        findings.extend(linter.run(jax_in_scope=_mentions_jax(scope_node)))
+    # Module-level A204 double counts nothing: nested defs are skipped by
+    # _ordered_nodes, so each clock call belongs to exactly one scope.
+    return findings
+
+
+def analyze_file(path: str) -> list[Finding]:
+    rel = os.path.relpath(path, os.getcwd())
+    rel = path if rel.startswith("..") else rel
+    with open(path, "r", encoding="utf-8") as f:
+        return analyze_source(f.read(), rel)
+
+
+def iter_python_files(roots: list[str]) -> list[str]:
+    files: list[str] = []
+    for root in roots:
+        if os.path.isfile(root):
+            files.append(root)
+            continue
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", ".git")]
+            files.extend(os.path.join(dirpath, f)
+                         for f in sorted(filenames) if f.endswith(".py"))
+    return sorted(files)
+
+
+def analyze_tree(roots: list[str]) -> list[Finding]:
+    findings: list[Finding] = []
+    for path in iter_python_files(roots):
+        findings.extend(analyze_file(path))
+    return findings

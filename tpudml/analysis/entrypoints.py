@@ -1,0 +1,248 @@
+"""Registry of traceable train-step entrypoints for the jaxpr pass.
+
+Each builder constructs the *real* engine from the parallel layer — the
+same classes the tasks instantiate — around a deliberately tiny model,
+then hands back the raw jitted program (``step.jitted``, attached by
+every engine's ``make_train_step``) plus matching abstract-shaped
+inputs. Tracing that program on CPU walks the identical jaxpr that
+would lower for a TPU slice: shard_map axis bindings, collectives,
+donation annotations and all. Nothing here requires accelerator
+hardware, only >= 2 visible devices (the CLI forces an 8-device host
+platform before importing jax; the test suite's conftest does the same).
+
+Coverage vs the parallel layer:
+
+==============  =====================================  ================
+entrypoint      engine / step builder                  task analogue
+==============  =====================================  ================
+task1_single    tpudml.train.make_train_step           task1
+task2_dp        parallel/dp.py DataParallel (fused)    task2, task3
+task4_mp        parallel/mp.py GSPMDParallel           task4
+fsdp            parallel/fsdp.py FSDP                  task5 --mode fsdp
+pp_gpipe        parallel/pp.py GPipe                   task5 --mode pp
+cp_ring         parallel/cp.py ContextParallel         task5 --mode cp
+ep_moe          parallel/ep.py ExpertParallel          task5 --mode ep
+lm_bf16         make_train_step on a bf16 LM           task5 --mode single
+==============  =====================================  ================
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+from tpudml.analysis.findings import Finding
+from tpudml.analysis.jaxpr_pass import analyze_callable
+
+
+@dataclass(frozen=True)
+class Program:
+    """One traceable device program: a jitted callable + example args."""
+
+    name: str
+    fn: Callable
+    args: tuple
+    expects_donation: bool = True
+
+
+def _np():
+    import numpy as np
+    return np
+
+
+def _mesh(axis: str, size: int):
+    import jax
+    from tpudml.core.config import MeshConfig
+    from tpudml.core.dist import make_mesh
+
+    if len(jax.devices()) < size:
+        raise RuntimeError(
+            f"need {size} devices for axis '{axis}', have "
+            f"{len(jax.devices())} — set "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count=8")
+    return make_mesh(MeshConfig({axis: size}), jax.devices()[:size])
+
+
+def _lenet_batch(n=4):
+    np = _np()
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, 28, 28, 1)).astype(np.float32)
+    y = rng.integers(0, 10, size=(n,)).astype(np.int32)
+    return x, y
+
+
+def _lm_batch(b=2, t=8, vocab=32):
+    np = _np()
+    rng = np.random.default_rng(0)
+    seqs = rng.integers(0, vocab, size=(b, t + 1)).astype(np.int32)
+    return seqs[:, :-1], seqs[:, 1:]
+
+
+def _tiny_lm(**kw):
+    from tpudml.models import TransformerLM
+
+    base = dict(vocab_size=32, embed_dim=16, num_heads=2, num_layers=1,
+                max_len=8)
+    base.update(kw)
+    return TransformerLM(**base)
+
+
+def build_task1_single() -> list[Program]:
+    from tpudml.core.prng import seed_key
+    from tpudml.models import LeNet
+    from tpudml.optim import make_optimizer
+    from tpudml.train import TrainState, make_train_step
+
+    model, opt = LeNet(), make_optimizer("sgd", 0.01)
+    ts = TrainState.create(model, opt, seed_key(0))
+    step = make_train_step(model, opt)  # already the jitted program
+    x, y = _lenet_batch()
+    return [Program("task1_single", step, (ts, x, y))]
+
+
+def build_task2_dp() -> list[Program]:
+    from tpudml.core.prng import seed_key
+    from tpudml.models import LeNet
+    from tpudml.optim import make_optimizer
+    from tpudml.parallel.dp import DataParallel
+
+    dp = DataParallel(LeNet(), make_optimizer("sgd", 0.01), _mesh("data", 2))
+    ts = dp.create_state(seed_key(0))
+    step = dp.make_train_step()
+    x, y = _lenet_batch()
+    return [Program("task2_dp", step.jitted, (ts, x, y))]
+
+
+def build_task4_mp() -> list[Program]:
+    from tpudml.core.prng import seed_key
+    from tpudml.models import LeNet
+    from tpudml.optim import make_optimizer
+    from tpudml.parallel.mp import GSPMDParallel
+
+    mp = GSPMDParallel(LeNet(), make_optimizer("sgd", 0.01),
+                       _mesh("stage", 2))
+    ts = mp.create_state(seed_key(0))
+    step = mp.make_train_step()
+    x, y = _lenet_batch()
+    return [Program("task4_mp", step.jitted, (ts, x, y))]
+
+
+def build_fsdp() -> list[Program]:
+    from tpudml.core.prng import seed_key
+    from tpudml.models import ForwardMLP
+    from tpudml.optim import make_optimizer
+    from tpudml.parallel.fsdp import FSDP
+
+    eng = FSDP(ForwardMLP(), make_optimizer("adam", 1e-3), _mesh("data", 2))
+    ts = eng.create_state(seed_key(0))
+    step = eng.make_train_step()
+    np = _np()
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(4, 784)).astype(np.float32)
+    y = rng.integers(0, 10, size=(4,)).astype(np.int32)
+    return [Program("fsdp", step.jitted, (ts, x, y))]
+
+
+def build_pp_gpipe() -> list[Program]:
+    import jax
+    from tpudml.core.prng import seed_key
+    from tpudml.nn.layers import Activation, Dense, Sequential
+    from tpudml.optim import make_optimizer
+    from tpudml.parallel.pp import GPipe
+
+    pipe = GPipe(
+        Sequential((Dense(8, 8), Activation(jax.nn.relu))),
+        n_microbatches=2,
+        mesh=_mesh("stage", 2),
+        optimizer=make_optimizer("sgd", 0.05),
+        prologue=Dense(4, 8),
+        epilogue=Dense(8, 4),
+    )
+    ts = pipe.create_state(seed_key(0))
+    step = pipe.make_train_step()
+    np = _np()
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(4, 4)).astype(np.float32)
+    y = rng.integers(0, 4, size=(4,)).astype(np.int32)
+    return [Program("pp_gpipe", step.jitted, (ts, x, y))]
+
+
+def build_cp_ring() -> list[Program]:
+    from tpudml.core.prng import seed_key
+    from tpudml.optim import make_optimizer
+    from tpudml.parallel.cp import ContextParallel
+
+    lm = _tiny_lm(impl="ring", seq_sharded=True)
+    cp = ContextParallel(lm, make_optimizer("sgd", 0.1), _mesh("seq", 2))
+    ts = cp.create_state(seed_key(0))
+    step = cp.make_train_step()
+    x, y = _lm_batch()
+    return [Program("cp_ring", step.jitted, (ts, x, y))]
+
+
+def build_ep_moe() -> list[Program]:
+    from tpudml.core.prng import seed_key
+    from tpudml.optim import make_optimizer
+    from tpudml.parallel.ep import ExpertParallel
+
+    lm = _tiny_lm(moe_experts=2, moe_axis="expert")
+    ep = ExpertParallel(lm, make_optimizer("adam", 0.01), _mesh("expert", 2))
+    ts = ep.create_state(seed_key(0))
+    step = ep.make_train_step()
+    x, y = _lm_batch()
+    return [Program("ep_moe", step.jitted, (ts, x, y))]
+
+
+def build_lm_bf16() -> list[Program]:
+    import jax.numpy as jnp
+    from tpudml.core.prng import seed_key
+    from tpudml.optim import make_optimizer
+    from tpudml.train import TrainState, make_train_step
+
+    lm = _tiny_lm(dtype=jnp.bfloat16)
+    opt = make_optimizer("sgd", 0.01)
+    ts = TrainState.create(lm, opt, seed_key(0))
+    step = make_train_step(lm, opt)
+    x, y = _lm_batch()
+    return [Program("lm_bf16", step, (ts, x, y))]
+
+
+#: name -> builder; order is reporting order.
+ENTRYPOINTS: dict[str, Callable[[], list[Program]]] = {
+    "task1_single": build_task1_single,
+    "task2_dp": build_task2_dp,
+    "task4_mp": build_task4_mp,
+    "fsdp": build_fsdp,
+    "pp_gpipe": build_pp_gpipe,
+    "cp_ring": build_cp_ring,
+    "ep_moe": build_ep_moe,
+    "lm_bf16": build_lm_bf16,
+}
+
+
+def analyze_entrypoint(name: str) -> list[Finding]:
+    """Build one entrypoint and run every jaxpr rule on its program(s).
+
+    A builder that raises becomes a J100 finding rather than an
+    exception: an entrypoint that cannot even be constructed on CPU is
+    itself a pre-flight failure worth reporting.
+    """
+    builder = ENTRYPOINTS[name]
+    try:
+        programs = builder()
+    except Exception as e:  # noqa: BLE001 - converted to a finding
+        return [Finding("J100", f"entrypoint failed to build: {e!r}",
+                        entrypoint=name)]
+    findings: list[Finding] = []
+    for prog in programs:
+        findings.extend(analyze_callable(
+            prog.fn, prog.args, entrypoint=prog.name,
+            expects_donation=prog.expects_donation))
+    return findings
+
+
+def analyze_entrypoints(names: list[str] | None = None) -> list[Finding]:
+    findings: list[Finding] = []
+    for name in names or list(ENTRYPOINTS):
+        findings.extend(analyze_entrypoint(name))
+    return findings

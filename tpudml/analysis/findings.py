@@ -1,0 +1,95 @@
+"""Finding model + rule registry for the static-analysis suite.
+
+Every rule has a stable id (J1xx = jaxpr pass, A2xx = AST pass), a
+severity, and a one-line contract. Findings carry file:line provenance —
+the jaxpr pass pulls it from equation ``source_info`` (so a hazard inside
+a traced step still points at the Python line that built it), the AST
+pass from the node. The committed allowlist (``allowlist.toml``) matches
+on (rule, path[, line]) and is how triaged true-but-accepted findings
+stay visible without failing ``--strict`` CI.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+ERROR = "error"
+WARN = "warn"
+INFO = "info"
+
+_SEV_ORDER = {ERROR: 0, WARN: 1, INFO: 2}
+
+#: rule id -> (severity, one-line description)
+RULES: dict[str, tuple[str, str]] = {
+    "J100": (ERROR, "entrypoint failed to trace (abstract evaluation error)"),
+    "J101": (ERROR, "collective axis name not bound by an enclosing "
+                    "shard_map/pmap"),
+    "J102": (WARN, "cond/switch branches issue different collective "
+                   "sequences (multi-host deadlock hazard)"),
+    "J103": (WARN, "host callback primitive inside a jitted step"),
+    "J104": (INFO, "bf16 value upcast to f32 outside an accumulation site"),
+    "J105": (WARN, "large constant (>1 MiB) captured by closure instead of "
+                   "passed as an argument"),
+    "J106": (WARN, "large training-state buffers are never donated"),
+    "A201": (WARN, "Python for/if over a traced (jnp/lax) value"),
+    "A202": (WARN, "jax.random key consumed more than once without split"),
+    "A203": (WARN, "epoch loop iterates a loader without set_epoch"),
+    "A204": (WARN, "host-clock timing without block_until_ready bracket"),
+}
+
+HINTS: dict[str, str] = {
+    "J100": "run the entrypoint eagerly under JAX_PLATFORMS=cpu to reproduce",
+    "J101": "name the axis in the enclosing shard_map mesh / pmap axis_name",
+    "J102": "hoist the collective out of the branches (or issue it in both)",
+    "J103": "drop jax.debug.* / callbacks from production steps; they "
+            "force host sync every step",
+    "J104": "cast back to bf16 after the reduction, or wrap the site in an "
+            "explicit accumulation (this rule allowlists cleanly)",
+    "J105": "pass the array as a (donated) argument so XLA can alias it",
+    "J106": "jit the step with donate_argnums on the TrainState",
+    "A201": "use lax.cond/lax.fori_loop/jnp.where, or materialize with "
+            "float(...) first if this is host-side code",
+    "A202": "key, sub = jax.random.split(key) before the second use",
+    "A203": "call loader.set_epoch(epoch) so shuffles differ per epoch",
+    "A204": "jax.block_until_ready(...) before reading the second clock",
+}
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One diagnostic: rule id + provenance + human-readable message."""
+
+    rule: str
+    message: str
+    file: str = ""
+    line: int = 0
+    entrypoint: str = ""  # jaxpr pass: which traced step surfaced it
+
+    @property
+    def severity(self) -> str:
+        return RULES.get(self.rule, (WARN, ""))[0]
+
+    @property
+    def hint(self) -> str:
+        return HINTS.get(self.rule, "")
+
+    def location(self) -> str:
+        if self.file and self.line:
+            return f"{self.file}:{self.line}"
+        return self.file or (f"<{self.entrypoint}>" if self.entrypoint else "?")
+
+    def format(self) -> str:
+        ep = f" [{self.entrypoint}]" if self.entrypoint else ""
+        out = (f"{self.rule} {self.severity:5s} {self.location()}{ep}: "
+               f"{self.message}")
+        if self.hint:
+            out += f"\n      hint: {self.hint}"
+        return out
+
+
+def sort_findings(findings: list[Finding]) -> list[Finding]:
+    return sorted(
+        findings,
+        key=lambda f: (_SEV_ORDER.get(f.severity, 9), f.rule, f.file, f.line),
+    )

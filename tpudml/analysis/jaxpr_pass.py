@@ -1,0 +1,352 @@
+"""Pass 1 — jaxpr analysis of traced train steps.
+
+Walks a ``ClosedJaxpr`` (recursing through pjit/scan/while/cond/shard_map
+sub-jaxprs while tracking which collective axis names are bound) and
+flags the hazard classes that otherwise fail only at runtime on a
+multi-host slice:
+
+- J101  collectives whose axis name is not bound by an enclosing
+        shard_map/pmap (the same class of bug also surfaces as a trace
+        NameError — ``analyze_callable`` converts that to J101 too);
+- J102  cond/switch branches that issue different collective sequences —
+        with a shard-dependent predicate this deadlocks the slice;
+- J103  host callback primitives inside the step (debug prints,
+        pure/io_callback): every call is a device→host sync;
+- J104  bf16→f32 upcast edges whose results feed non-accumulating
+        consumers (mixed-precision leaks that silently re-inflate
+        bandwidth); explicit accumulation (reductions, dots) is exempt;
+- J105  large (>1 MiB) arrays captured as jaxpr constants — baked into
+        the program instead of passed (and donated) as arguments;
+- J106  (from the lowered module, not the jaxpr) steps whose large
+        inputs carry no donation aliasing at all.
+
+The pass is backend-free: everything works on abstract values on CPU.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Callable, Iterable
+
+from tpudml.analysis.findings import Finding
+
+# Primitives that require a bound axis name (J101). The subset that
+# actually communicates (everything but axis_index) forms the J102
+# branch signature.
+COLLECTIVE_PRIMS = frozenset({
+    "psum", "pmax", "pmin", "ppermute", "pbroadcast", "all_gather",
+    "all_to_all", "reduce_scatter", "psum_scatter", "pgather", "axis_index",
+})
+COMM_PRIMS = COLLECTIVE_PRIMS - {"axis_index"}
+
+CALLBACK_PRIMS = frozenset({
+    "debug_callback", "pure_callback", "io_callback", "callback",
+    "outside_call", "host_callback_call", "infeed", "outfeed",
+})
+
+# Direct consumers under which a bf16→f32 upcast is the intended
+# accumulate-in-f32 idiom (J104 stays silent).
+ACCUM_OK_PRIMS = frozenset({
+    "reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
+    "reduce_precision", "dot_general", "conv_general_dilated",
+    "cumsum", "cumprod", "cumlogsumexp", "cummax", "cummin",
+    "scan", "while", "psum", "psum_scatter", "reduce_scatter",
+    "convert_element_type",
+})
+
+LARGE_CONST_BYTES = 1 << 20  # 1 MiB
+
+
+def _repo_rel(path: str) -> str:
+    """Repo/cwd-relative path for stable reporting + allowlist matching."""
+    if not path:
+        return path
+    cwd = os.getcwd()
+    try:
+        rel = os.path.relpath(path, cwd)
+    except ValueError:  # pragma: no cover - different drive (windows)
+        return path
+    return path if rel.startswith("..") else rel
+
+
+def _src_loc(eqn) -> tuple[str, int]:
+    """(file, line) of the user frame that built an equation."""
+    try:
+        from jax._src import source_info_util
+
+        frame = source_info_util.user_frame(eqn.source_info)
+        if frame is not None:
+            return _repo_rel(frame.file_name), int(frame.start_line)
+    except Exception:
+        pass
+    return "", 0
+
+
+def _axis_strs(value: Any) -> tuple[str, ...]:
+    """String axis names out of an ``axes``/``axis_name`` param value
+    (str | int | tuple thereof; ints are positional vmap axes)."""
+    if isinstance(value, str):
+        return (value,)
+    if isinstance(value, (tuple, list, frozenset, set)):
+        out: list[str] = []
+        for v in value:
+            out.extend(_axis_strs(v))
+        return tuple(out)
+    return ()
+
+
+def _eqn_axes(eqn) -> tuple[str, ...]:
+    axes: list[str] = []
+    for key in ("axes", "axis_name"):
+        if key in eqn.params:
+            axes.extend(_axis_strs(eqn.params[key]))
+    return tuple(axes)
+
+
+def _inner_jaxpr(obj):
+    """Normalize Jaxpr | ClosedJaxpr -> (Jaxpr, consts)."""
+    if hasattr(obj, "jaxpr"):  # ClosedJaxpr
+        return obj.jaxpr, getattr(obj, "consts", ())
+    return obj, ()
+
+
+def _is_jaxpr_like(obj) -> bool:
+    return hasattr(obj, "eqns") or (
+        hasattr(obj, "jaxpr") and hasattr(obj.jaxpr, "eqns")
+    )
+
+
+def _sub_jaxprs(eqn) -> Iterable[tuple[Any, frozenset[str]]]:
+    """(sub-jaxpr, extra bound axes) pairs under an equation."""
+    extra: frozenset[str] = frozenset()
+    name = eqn.primitive.name
+    if name == "shard_map":
+        mesh = eqn.params.get("mesh")
+        if mesh is not None:
+            extra = frozenset(str(a) for a in mesh.axis_names)
+    elif name in ("xla_pmap", "pmap"):
+        extra = frozenset(_axis_strs(eqn.params.get("axis_name", ())))
+    for val in eqn.params.values():
+        if _is_jaxpr_like(val):
+            yield val, extra
+        elif isinstance(val, (tuple, list)):
+            for item in val:
+                if _is_jaxpr_like(item):
+                    yield item, extra
+
+
+def _collective_signature(obj) -> tuple[tuple[str, tuple[str, ...]], ...]:
+    """Ordered (prim, axes) sequence of communicating collectives inside a
+    jaxpr, recursing through sub-jaxprs — the J102 branch fingerprint."""
+    jaxpr, _ = _inner_jaxpr(obj)
+    sig: list[tuple[str, tuple[str, ...]]] = []
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name in COMM_PRIMS:
+            sig.append((eqn.primitive.name, tuple(sorted(_eqn_axes(eqn)))))
+        for sub, _extra in _sub_jaxprs(eqn):
+            sig.extend(_collective_signature(sub))
+    return tuple(sig)
+
+
+def _check_upcasts(jaxpr, entrypoint: str, findings: list[Finding]) -> None:
+    """J104 within one jaxpr level: convert_element_type bf16→f32 whose
+    result has a non-accumulating direct consumer."""
+    import numpy as np
+
+    consumers: dict[int, list[str]] = {}
+    for eqn in jaxpr.eqns:
+        for v in eqn.invars:
+            if hasattr(v, "count") or type(v).__name__ == "Var":
+                consumers.setdefault(id(v), []).append(eqn.primitive.name)
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name != "convert_element_type":
+            continue
+        try:
+            src_dtype = eqn.invars[0].aval.dtype
+            dst_dtype = np.dtype(eqn.params["new_dtype"])
+        except Exception:
+            continue
+        if str(src_dtype) != "bfloat16" or str(dst_dtype) != "float32":
+            continue
+        used_by = consumers.get(id(eqn.outvars[0]), [])
+        bad = [p for p in used_by if p not in ACCUM_OK_PRIMS]
+        if bad:
+            f, ln = _src_loc(eqn)
+            findings.append(Finding(
+                "J104",
+                f"bf16 value upcast to f32 feeds non-accumulating "
+                f"consumer(s) {sorted(set(bad))}",
+                file=f, line=ln, entrypoint=entrypoint,
+            ))
+
+
+def _walk(obj, bound: frozenset[str], entrypoint: str,
+          findings: list[Finding]) -> None:
+    jaxpr, consts = _inner_jaxpr(obj)
+    _check_consts(consts, entrypoint, findings)
+    _check_upcasts(jaxpr, entrypoint, findings)
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name in COLLECTIVE_PRIMS:
+            missing = [a for a in _eqn_axes(eqn) if a not in bound]
+            if missing:
+                f, ln = _src_loc(eqn)
+                findings.append(Finding(
+                    "J101",
+                    f"{name} over axis {missing} but enclosing "
+                    f"shard_map/pmap binds {sorted(bound) or 'no axes'}",
+                    file=f, line=ln, entrypoint=entrypoint,
+                ))
+        if name in CALLBACK_PRIMS:
+            f, ln = _src_loc(eqn)
+            cb = eqn.params.get("callback", None)
+            detail = f" ({getattr(cb, '__name__', cb)})" if cb is not None else ""
+            findings.append(Finding(
+                "J103",
+                f"host callback primitive {name}{detail} inside the "
+                f"jitted step",
+                file=f, line=ln, entrypoint=entrypoint,
+            ))
+        if name == "cond":
+            branches = eqn.params.get("branches", ())
+            sigs = [_collective_signature(b) for b in branches]
+            if sigs and any(s != sigs[0] for s in sigs[1:]):
+                f, ln = _src_loc(eqn)
+                desc = "; ".join(
+                    f"branch {i}: " + (
+                        ", ".join(p for p, _ in s) if s else "<none>")
+                    for i, s in enumerate(sigs)
+                )
+                findings.append(Finding(
+                    "J102",
+                    f"cond/switch branches issue different collective "
+                    f"sequences — {desc}",
+                    file=f, line=ln, entrypoint=entrypoint,
+                ))
+        for sub, extra in _sub_jaxprs(eqn):
+            _walk(sub, bound | extra, entrypoint, findings)
+
+
+def _check_consts(consts, entrypoint: str, findings: list[Finding]) -> None:
+    for c in consts:
+        nbytes = getattr(c, "nbytes", 0)
+        if nbytes and nbytes > LARGE_CONST_BYTES:
+            shape = getattr(c, "shape", ())
+            dtype = getattr(c, "dtype", "?")
+            findings.append(Finding(
+                "J105",
+                f"{nbytes / (1 << 20):.1f} MiB constant "
+                f"({dtype}{list(shape)}) captured by closure — pass it as "
+                f"a (donatable) argument instead",
+                entrypoint=entrypoint,
+            ))
+
+
+def analyze_closed_jaxpr(closed, entrypoint: str = "") -> list[Finding]:
+    """All jaxpr-level findings (J101-J105) for one traced program."""
+    findings: list[Finding] = []
+    _walk(closed, frozenset(), entrypoint, findings)
+    return findings
+
+
+# ------------------------------------------------------------- donation
+
+_MAIN_SIG_RE = re.compile(
+    r"func\.func public @main\((.*?)\)\s*->", re.DOTALL)
+_ARG_RE = re.compile(r"%arg\d+: tensor<([^>]*)>\s*(\{[^}]*\})?")
+
+_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "f16": 2, "bf16": 2, "f8e4m3fn": 1, "f8e5m2": 1,
+    "i64": 8, "ui64": 8, "i32": 4, "ui32": 4, "i16": 2, "ui16": 2,
+    "i8": 1, "ui8": 1, "i1": 1, "c64": 8, "c128": 16,
+}
+
+
+def _tensor_bytes(spec: str) -> int:
+    parts = spec.strip().split("x")
+    dtype = parts[-1]
+    n = 1
+    for d in parts[:-1]:
+        try:
+            n *= int(d)
+        except ValueError:  # dynamic dim — treat as 1
+            pass
+    return n * _DTYPE_BYTES.get(dtype, 4)
+
+
+def donation_findings(
+    lowered_text: str,
+    entrypoint: str = "",
+    min_bytes: int = LARGE_CONST_BYTES,
+) -> list[Finding]:
+    """J106 from a lowered StableHLO module: large entry args with no
+    donation aliasing anywhere. (Per-arg precision is deliberate-ly NOT
+    attempted — batch inputs legitimately go undonated; the hazard is a
+    step whose whole TrainState is undonated, i.e. zero aliased args.)"""
+    m = _MAIN_SIG_RE.search(lowered_text)
+    if not m:
+        return []
+    donated_bytes = 0
+    undonated_large = 0
+    undonated_bytes = 0
+    for spec, attrs in _ARG_RE.findall(m.group(1)):
+        nbytes = _tensor_bytes(spec)
+        if attrs and ("tf.aliasing_output" in attrs
+                      or "jax.buffer_donor" in attrs):
+            donated_bytes += nbytes
+        elif nbytes >= min_bytes:
+            undonated_large += 1
+            undonated_bytes += nbytes
+    if donated_bytes == 0 and undonated_large > 0:
+        return [Finding(
+            "J106",
+            f"{undonated_bytes / (1 << 20):.1f} MiB across "
+            f"{undonated_large} large input(s) and no argument is donated "
+            f"— params/opt-state double-buffer every step",
+            entrypoint=entrypoint,
+        )]
+    return []
+
+
+# ----------------------------------------------------------- callable API
+
+def analyze_callable(
+    fn: Callable,
+    args: tuple,
+    entrypoint: str = "",
+    expects_donation: bool = False,
+) -> list[Finding]:
+    """Trace ``fn(*args)`` abstractly and run every jaxpr rule on it.
+
+    Unbound-axis collectives abort the trace itself (JAX raises
+    ``NameError`` at bind time), so that failure mode is caught here and
+    reported as J101 rather than ever reaching ``_walk``. Other trace
+    failures surface as J100 — a step that cannot even abstract-eval
+    will not run on the chip either.
+    """
+    import jax
+
+    try:
+        closed = jax.make_jaxpr(fn)(*args)
+    except NameError as e:
+        if "unbound axis name" in str(e):
+            return [Finding(
+                "J101",
+                f"trace failed: {e} — collective issued outside any "
+                f"shard_map/pmap binding that axis",
+                entrypoint=entrypoint,
+            )]
+        return [Finding("J100", f"trace failed: {e!r}", entrypoint=entrypoint)]
+    except Exception as e:  # noqa: BLE001 - converted to a finding
+        return [Finding("J100", f"trace failed: {e!r}", entrypoint=entrypoint)]
+    findings = analyze_closed_jaxpr(closed, entrypoint)
+    if expects_donation and hasattr(fn, "lower"):
+        try:
+            text = fn.lower(*args).as_text()
+        except Exception as e:  # noqa: BLE001 - converted to a finding
+            findings.append(Finding(
+                "J100", f"lowering failed: {e!r}", entrypoint=entrypoint))
+        else:
+            findings.extend(donation_findings(text, entrypoint))
+    return findings

@@ -26,6 +26,20 @@ from jax import lax
 PyTree = Any
 
 
+def axis_size(axis_name: str) -> int:
+    """Static size of a bound mesh axis.
+
+    ``lax.axis_size`` is newer than the pinned jax (0.4.37 raises
+    AttributeError — tpudml.analysis rule J100 caught this breaking every
+    ring/CP path); ``psum`` of the literal 1 is the long-standing static
+    equivalent and constant-folds to a Python int at trace time.
+    """
+    fn = getattr(lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    return lax.psum(1, axis_name)
+
+
 def psum_tree(tree: PyTree, axis_name: str) -> PyTree:
     """AllReduce-SUM over every leaf of a pytree (one traced program)."""
     return jax.tree.map(lambda x: lax.psum(x, axis_name), tree)
@@ -77,7 +91,7 @@ def reduce_scatter_average_gradients(grads: PyTree, axis_name: str = "data") -> 
     task2.tex:11). Leading dim of each leaf must divide the axis size; falls
     back to pmean for leaves where it doesn't.
     """
-    world = lax.axis_size(axis_name)
+    world = axis_size(axis_name)
 
     def rs_ag(g):
         if g.ndim >= 1 and g.shape[0] % world == 0:
@@ -126,7 +140,7 @@ def ppermute_ring(x: jax.Array, axis_name: str, shift: int = 1) -> jax.Array:
     The primitive under ring-allreduce and ring attention (SURVEY.md §5.7
     scope note: exposed so the SP door stays open).
     """
-    world = lax.axis_size(axis_name)
+    world = axis_size(axis_name)
     perm = [(i, (i + shift) % world) for i in range(world)]
     return lax.ppermute(x, axis_name, perm)
 

@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from tpudml.comm.collectives import axis_size
 from tpudml.nn.attention import MultiHeadAttention, sharded_positions
 from tpudml.nn.layers import Dense, LayerNorm, Module
 
@@ -276,7 +277,7 @@ class TransformerEmbed(Module):
     def apply(self, params, state, tokens, *, train=False, rng=None):
         t_local = tokens.shape[1]
         t_global = (
-            lax.axis_size(self.axis_name) * t_local if self.seq_sharded else t_local
+            axis_size(self.axis_name) * t_local if self.seq_sharded else t_local
         )
         if self.use_pos_embed and t_global > self.max_len:
             # Trace-time guard: out-of-range gathers clamp silently under

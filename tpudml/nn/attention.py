@@ -22,6 +22,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
+from tpudml.comm.collectives import axis_size
 from tpudml.nn.layers import Dense, Module
 
 NEG_INF = -1e30  # large-finite mask value: avoids inf-inf → NaN in softmax
@@ -37,7 +38,7 @@ def sharded_positions(
     if not seq_sharded:
         return jnp.arange(t_local)
     if seq_layout == "striped":
-        world = jax.lax.axis_size(axis_name)
+        world = axis_size(axis_name)
         return jax.lax.axis_index(axis_name) + world * jnp.arange(t_local)
     return jax.lax.axis_index(axis_name) * t_local + jnp.arange(t_local)
 

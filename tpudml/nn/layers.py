@@ -209,17 +209,27 @@ class BatchNorm(Module):
     def apply(self, params, state, x, *, train=False, rng=None):
         axes = tuple(range(x.ndim - 1))
         if train:
-            # Single-pass moments in f32 accumulated straight off the (possibly
-            # bf16) stream: sum and sum-of-squares reduce in ONE fused read of
-            # x instead of jnp.var's mean-then-deviations second pass, and the
-            # stream is never materialized as an f32 copy. Same clamped
-            # E[x²] − m² form as LayerNorm (cancellation can go slightly
-            # negative in f32; rsqrt(negative + eps) would NaN-poison the step).
             mean = jnp.mean(x, axes, dtype=jnp.float32)
-            var = jnp.maximum(
-                jnp.mean(jnp.square(x.astype(jnp.float32)), axes) - jnp.square(mean),
-                0.0,
-            )
+            if x.dtype == jnp.bfloat16:
+                # Single-pass moments in f32 accumulated straight off the bf16
+                # stream: sum and sum-of-squares reduce in ONE fused read of
+                # x instead of jnp.var's mean-then-deviations second pass, and
+                # the stream is never materialized as an f32 copy. Clamped
+                # E[x²] − m² (cancellation can go slightly negative in f32;
+                # rsqrt(negative + eps) would NaN-poison the step). The bf16
+                # input already bounds the stats' accuracy, so the single-pass
+                # cancellation is below the quantization floor.
+                var = jnp.maximum(
+                    jnp.mean(jnp.square(x.astype(jnp.float32)), axes)
+                    - jnp.square(mean),
+                    0.0,
+                )
+            else:
+                # Two-pass E[(x−m)²] for f32 inputs: at large activation
+                # means (m² ≫ var) the single-pass form loses ALL variance
+                # bits to f32 cancellation and the clamp silently returns
+                # var=0 — normalization then amplifies by rsqrt(eps).
+                var = jnp.mean(jnp.square(x.astype(jnp.float32) - mean), axes)
             m = self.momentum
             new_state = {
                 "mean": m * state["mean"] + (1 - m) * mean.astype(state["mean"].dtype),

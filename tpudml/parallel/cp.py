@@ -42,7 +42,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from tpudml.comm.collectives import all_to_all, pmean_tree, ppermute_ring
+from tpudml.comm.collectives import all_to_all, axis_size, pmean_tree, ppermute_ring
 from tpudml.nn.attention import NEG_INF
 from tpudml.nn.layers import Module
 from tpudml.nn.losses import accuracy, softmax_cross_entropy
@@ -146,8 +146,11 @@ def _ring_fwd(axis_name, causal, flash_cfg, q, k, v):
     The ppermute rotation runs every tick regardless — collectives must
     stay unconditional across the mesh."""
     use_flash, interpret, striped = flash_cfg
-    world = lax.axis_size(axis_name)
-    idx = lax.axis_index(axis_name)
+    world = axis_size(axis_name)
+    # Only the causal masks read the device index. Keep axis_index out of
+    # the non-causal program entirely: a dead partition-id survives into
+    # the lowered module and the CPU SPMD partitioner rejects it.
+    idx = lax.axis_index(axis_name) if causal else None
     b, t_local, h, d = q.shape
 
     def block_fwd(q_, kb, vb, diag, k_shift=0):
@@ -171,7 +174,7 @@ def _ring_fwd(axis_name, causal, flash_cfg, q, k, v):
         acc, kb, vb = carry
         kb = ppermute_ring(kb, axis_name)
         vb = ppermute_ring(vb, axis_name)
-        src = (idx - step) % world
+        src = (idx - step) % world if causal else None
         if causal and striped:
             # k_shift must be static for the kernel; both variants are the
             # same triangular tile up to the diagonal inclusion.
@@ -218,8 +221,9 @@ def _ring_attn_bwd(axis_name, causal, flash_cfg, res, g):
     shard), independent of the ring size."""
     use_flash, interpret, striped = flash_cfg
     q, k, v, out, lse = res
-    world = lax.axis_size(axis_name)
-    idx = lax.axis_index(axis_name)
+    world = axis_size(axis_name)
+    # As in the forward: a dead partition-id breaks CPU SPMD partitioning.
+    idx = lax.axis_index(axis_name) if causal else None
 
     delta = jnp.sum(
         g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
@@ -246,7 +250,7 @@ def _ring_attn_bwd(axis_name, causal, flash_cfg, res, g):
         vb = ppermute_ring(vb, axis_name)
         dkb = ppermute_ring(dkb, axis_name)
         dvb = ppermute_ring(dvb, axis_name)
-        src = (idx - step) % world
+        src = (idx - step) % world if causal else None
 
         def fold(args, diag=False, k_shift=0):
             dq_acc, dkb, dvb = args
@@ -339,7 +343,7 @@ def ulysses_attention(
     heads, full attention locally, reshard back."""
     from tpudml.nn.attention import dot_product_attention
 
-    world = lax.axis_size(axis_name)
+    world = axis_size(axis_name)
     if q.shape[2] % world:
         raise ValueError(
             f"ulysses needs num_heads {q.shape[2]} divisible by axis size {world}"
@@ -572,4 +576,6 @@ class ContextParallel:
             self._throttle.after_step(out[1]["loss"])
             return out
 
+        # Raw program for tpudml.analysis (wrapper does host-side work).
+        step.jitted = jitted
         return step

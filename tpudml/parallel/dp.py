@@ -260,6 +260,10 @@ class DataParallel:
             self._throttle.after_step(out[1]["loss"])
             return out
 
+        # Expose the raw program for tpudml.analysis: the wrapper above
+        # does host work (shard_batch, throttle) that make_jaxpr must not
+        # see, but the jitted step is exactly what runs on the chip.
+        step.jitted = jitted
         return step
 
     # ----------------------------------------------------------- split step
@@ -349,6 +353,9 @@ class DataParallel:
             }
             return new_ts, metrics
 
+        # The three device programs, exposed for tpudml.analysis (the
+        # wrapper interleaves host timing/sleep between dispatches).
+        step.programs = (grad_fn, agg_fn, apply_fn)
         return step
 
 

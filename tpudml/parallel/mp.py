@@ -275,6 +275,8 @@ class GSPMDParallel:
             self._throttle.after_step(out[1]["loss"])
             return out
 
+        # Raw program for tpudml.analysis (wrapper does host-side work).
+        step.jitted = jitted
         return step
 
     # ------------------------------------------------------------- evaluate
