@@ -89,9 +89,10 @@ def parse_args(argv=None) -> argparse.Namespace:
         help="fused linear-cross-entropy head (Pallas) — the [B*T, V] "
         "logits are never materialized, trading ~2 ms/step of score "
         "recompute for O(B*T) head residual memory (very long T / large "
-        "vocab regimes); loss-only metrics. Composes with "
-        "--parallel single/dp/cp (the kernel is token-parallel); the "
-        "vocab-sharded TP head is documented in docs/API.md",
+        "vocab regimes); loss-only metrics. Composes with every "
+        "--parallel strategy except pp: single/dp/cp run the kernel "
+        "token-parallel, tp/fsdp run the vocab-sharded form (per-shard "
+        "partial stats merged by the online lse rule; docs/API.md)",
     )
     p.add_argument(
         "--target_loss", type=float, default=None,
@@ -156,19 +157,17 @@ def parse_args(argv=None) -> argparse.Namespace:
 def build_engine(args, devices):
     """(train_state, step_fn) for the selected strategy."""
     n = len(devices)
-    if getattr(args, "fused_xent", False) and args.parallel not in (
-        "single", "dp", "cp"
-    ):
-        # The kernel is token-parallel: it composes with any batch/seq
-        # sharding of the trunk (single/dp/cp), but NOT with a
-        # vocab-sharded head (tp/fsdp shard the head kernel's V dim —
-        # each shard's online softmax would see a partial vocab; see
-        # docs/API.md) nor with the pipeline epilogue (pp stages ship
-        # logits, not features).
+    if getattr(args, "fused_xent", False) and args.parallel == "pp":
+        # The one remaining exclusion: pipeline stages ship LOGITS
+        # between stages, so there is no pre-head feature tensor for the
+        # fused kernel to consume. Every other strategy composes:
+        # single/dp/cp run the token-parallel kernel per shard; tp/fsdp
+        # run the vocab-sharded form (per-shard partial statistics
+        # merged by the online log-sum-exp rule; see docs/API.md).
         raise ValueError(
-            "--fused_xent supports --parallel single/dp/cp "
-            "(token-parallel head); tp/fsdp/pp shard or relocate the "
-            "head itself"
+            "--fused_xent does not compose with --parallel pp: the "
+            "pipeline epilogue ships logits between stages, so there "
+            "is no feature tensor for the fused head to consume"
         )
     scores = getattr(args, "fused_xent_scores", False)
     lean = getattr(args, "fused_xent_lean", False)
@@ -262,7 +261,10 @@ def build_engine(args, devices):
         from tpudml.parallel.fsdp import FSDP
 
         mesh = make_mesh(MeshConfig({"data": n}), devices)
-        engine = FSDP(model, opt, mesh, rng_root=rng_root)
+        engine = FSDP(
+            model, opt, mesh, rng_root=rng_root,
+            fused_xent=args.fused_xent, save_scores=args._save_scores,
+        )
         return engine.create_state(seed_key(args.seed)), engine.make_train_step()
     if args.parallel == "pp":
         # One decoder block per pipeline stage; embed/head replicated.
@@ -321,6 +323,7 @@ def build_engine(args, devices):
     engine = GSPMDParallel(
         model, opt, mesh, rule=tensor_parallel_rules("model"),
         axis_name="model", rng_root=rng_root,
+        fused_xent=args.fused_xent, save_scores=args._save_scores,
     )
     return engine.create_state(seed_key(args.seed)), engine.make_train_step()
 

@@ -154,6 +154,72 @@ def test_j106_undonated_buffers():
     assert "J106" not in _rules(good)
 
 
+def test_j107_vocab_sharded_unsharded_head():
+    """J107 fires when the UNSHARDED fused head consumes a vocab-sharded
+    kernel inside shard_map — including through the 2-D W all_gather —
+    and stays silent for the shard-merge wrapper and a replicated W."""
+    from jax.sharding import PartitionSpec as P
+
+    from tpudml.core.config import MeshConfig
+    from tpudml.core.dist import make_mesh
+    from tpudml.ops.xent_kernel import (
+        linear_cross_entropy,
+        sharded_linear_cross_entropy,
+    )
+    from tpudml.parallel.sharding import shard_map_fn
+
+    mesh = make_mesh(MeshConfig({"data": 2}), jax.devices()[:2])
+    x = jnp.zeros((8, 4))
+    w = jnp.zeros((4, 32))
+    lab = jnp.zeros((8,), jnp.int32)
+
+    def wrap(body, w_spec):
+        return shard_map_fn(
+            body, mesh, in_specs=(P(), w_spec, P()), out_specs=P())
+
+    hazard = wrap(
+        lambda x, w, ln: linear_cross_entropy(x, w, ln), P(None, "data"))
+    assert "J107" in _rules(analyze_callable(hazard, (x, w, lab), "fix-j107"))
+
+    fixed = wrap(
+        lambda x, w, ln: sharded_linear_cross_entropy(
+            x, w, ln, axis_name="data"),
+        P(None, "data"))
+    assert "J107" not in _rules(analyze_callable(fixed, (x, w, lab), "ok"))
+
+    replicated = wrap(
+        lambda x, w, ln: linear_cross_entropy(x, w, ln), P())
+    assert "J107" not in _rules(
+        analyze_callable(replicated, (x, w, lab), "ok-replicated"))
+
+    # 2-D form: W sharded P(data, model); the dim-0 gather over "data"
+    # must not launder the vocab-dim sharding over "model".
+    mesh2 = make_mesh(MeshConfig({"data": 2, "model": 2}), jax.devices()[:4])
+
+    def hazard2d(x, w, ln):
+        def body(x, w, ln):
+            k = jax.lax.all_gather(w, "data", axis=0, tiled=True)
+            return linear_cross_entropy(x, k, ln)
+        return shard_map_fn(
+            body, mesh2, in_specs=(P(), P("data", "model"), P()),
+            out_specs=P())(x, w, ln)
+
+    bad2d = analyze_callable(hazard2d, (x, w, lab), "fix-j107-2d")
+    assert "J107" in _rules(bad2d)
+    (f,) = [f for f in bad2d if f.rule == "J107"]
+    assert "model" in f.message and "sharded_linear_cross_entropy" in f.message
+
+
+def test_j107_marker_names_match_kernel_module():
+    """The pass keys on string literals so it never imports kernel code;
+    this is the drift pin."""
+    from tpudml.analysis import jaxpr_pass
+    from tpudml.ops import xent_kernel
+
+    assert jaxpr_pass.FUSED_XENT_NAME == xent_kernel.FUSED_XENT_MARKER
+    assert jaxpr_pass.SHARDED_XENT_NAME == xent_kernel.SHARDED_XENT_MARKER
+
+
 def test_j100_trace_failure_becomes_finding():
     def broken(x):
         return x + jnp.ones((x.shape[0] + 1,))  # shape mismatch at trace
@@ -176,7 +242,8 @@ def test_donation_parser_reads_aliasing():
 # ----------------------------------------------- real engine entrypoints
 
 
-@pytest.mark.parametrize("name", ["task2_dp", "fsdp", "pp_gpipe"])
+@pytest.mark.parametrize(
+    "name", ["task2_dp", "fsdp", "pp_gpipe", "tp_fused", "fsdp_fused"])
 def test_entrypoints_trace_on_cpu(name):
     """The acceptance floor: the DP, FSDP, and pipeline steps trace and
     analyze without TPU hardware, with no error-severity findings and

@@ -95,3 +95,26 @@ def test_analytic_lm_flops_rejects_kv_heads_without_heads():
             dict(embed_dim=512, num_layers=6, vocab_size=32768, num_kv_heads=2),
             8, 1024,
         )
+
+
+def test_dryrun_sharded_fused_xent_regimes_compile():
+    """The vocab-sharded fused-head regimes (task5 --parallel tp/fsdp
+    --fused_xent) compile and run on the virtual CPU mesh — keeps the
+    shard_map loss region + lse-merge collectives tracing without a
+    chip."""
+    import __graft_entry__
+
+    __graft_entry__.dryrun_multichip(4, regimes=("tpfused", "fsdpfused"))
+
+
+def test_ablate_budget_mode_runs_on_cpu():
+    """The per-component budget mode (BASELINE.md round-6 table) at a
+    tiny config: all five ablation arms patch/build/run and the table
+    derives — so the one-process protocol is ready when chip time is."""
+    from tools import ablate_lm
+
+    total, comps = ablate_lm.budget(
+        batch=2, seq_len=16, vocab=64, layers=1, dim=16, heads=2
+    )
+    assert total > 0
+    assert set(comps) == {"attention", "junctions", "head", "embed", "adamw"}

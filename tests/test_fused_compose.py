@@ -161,6 +161,192 @@ def test_dp_fused_xent_matches_unfused():
     _assert_tree_close(ts_f.params, ts_u.params)
 
 
+# ------------------------------------------ sharded head (TP/FSDP) × fused
+
+
+def _tp_rules():
+    from tpudml.parallel.mp import tensor_parallel_rules
+
+    return tensor_parallel_rules("model")
+
+
+def test_tp_fused_xent_matches_unfused():
+    """Vocab-sharded fused head under tensor parallelism: per-shard
+    partial (lse, picked) statistics merged by the online lse rule train
+    the SAME trajectory as the unfused sharded logits path."""
+    from tpudml.parallel.mp import GSPMDParallel
+
+    mesh = make_mesh(MeshConfig({"model": 4}), jax.devices()[:4])
+    model = _lm(impl="full")
+
+    def eng(fused):
+        return GSPMDParallel(
+            model, make_optimizer("sgd", 0.05), mesh, rule=_tp_rules(),
+            axis_name="model", fused_xent=fused,
+        )
+
+    ts_f, loss_f = _run_steps(eng(True))
+    ts_u, loss_u = _run_steps(eng(False))
+    np.testing.assert_allclose(loss_f, loss_u, rtol=1e-5)
+    _assert_tree_close(ts_f.params, ts_u.params)
+
+
+def test_fsdp_fused_xent_matches_unfused():
+    """1-D FSDP shards tokens AND vocab over the same axis; the fused
+    path all-gathers tokens into the head region so each shard scores
+    all tokens against its vocab slice — grad-exact vs unfused FSDP."""
+    from tpudml.parallel.fsdp import FSDP
+
+    mesh = make_mesh(MeshConfig({"data": 4}), jax.devices()[:4])
+    model = _lm(impl="full")
+
+    def eng(fused):
+        return FSDP(model, make_optimizer("sgd", 0.05), mesh, fused_xent=fused)
+
+    ts_f, loss_f = _run_steps(eng(True))
+    ts_u, loss_u = _run_steps(eng(False))
+    np.testing.assert_allclose(loss_f, loss_u, rtol=1e-5)
+    _assert_tree_close(ts_f.params, ts_u.params)
+
+
+def test_fsdp_tp_fused_xent_matches_unfused():
+    """2-D FSDP×TP composition: head kernel P('data', 'model') — vocab
+    merge over model, W all-gathered over data on use (its transpose IS
+    the ZeRO reduce-scatter for dW), tokens stay data-sharded with a
+    final pmean. Grad-exact vs the unfused 2-D engine."""
+    from tpudml.parallel.fsdp import FSDP
+
+    mesh = make_mesh(MeshConfig({"data": 2, "model": 2}), jax.devices()[:4])
+    model = _lm(impl="full")
+
+    def eng(fused):
+        return FSDP(
+            model, make_optimizer("sgd", 0.05), mesh,
+            base_rule=_tp_rules(), fused_xent=fused,
+        )
+
+    ts_f, loss_f = _run_steps(eng(True))
+    ts_u, loss_u = _run_steps(eng(False))
+    np.testing.assert_allclose(loss_f, loss_u, rtol=1e-5)
+    _assert_tree_close(ts_f.params, ts_u.params)
+
+
+def test_tp_fused_xent_indivisible_vocab_falls_back():
+    """A vocab the mesh can't divide demotes the head spec to replicated
+    — the sharded loss fn then takes the plain full-vocab kernel path
+    inside the shard_map region, still matching the unfused engine."""
+    from tpudml.parallel.mp import GSPMDParallel
+
+    mesh = make_mesh(MeshConfig({"model": 4}), jax.devices()[:4])
+    model = _lm(impl="full", vocab_size=34)  # 34 % 4 != 0 -> demoted
+
+    def eng(fused):
+        return GSPMDParallel(
+            model, make_optimizer("sgd", 0.05), mesh, rule=_tp_rules(),
+            axis_name="model", fused_xent=fused,
+        )
+
+    ts_f, loss_f = _run_steps(eng(True))
+    ts_u, loss_u = _run_steps(eng(False))
+    np.testing.assert_allclose(loss_f, loss_u, rtol=1e-5)
+    _assert_tree_close(ts_f.params, ts_u.params)
+
+
+@pytest.mark.parametrize("save_s", [False, True])
+def test_sharded_kernel_path_grad_parity(save_s):
+    """The Pallas machinery itself (interpret mode) under every sharded
+    composition: value AND gradients match the unsharded reference at
+    the single-shard parity tolerances. The engine tests above exercise
+    the reference dispatch on CPU; this pins the kernel dispatch —
+    including the shard_map transpose convention the custom_vjp's
+    cotangent psum compensates for."""
+    from jax.sharding import PartitionSpec as P
+
+    from tpudml.ops.xent_kernel import (
+        linear_cross_entropy,
+        sharded_linear_cross_entropy,
+    )
+    from tpudml.parallel.sharding import shard_map_fn
+
+    n, d, v = 16, 8, 64
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+    w = jnp.asarray(rng.normal(size=(d, v)).astype(np.float32))
+    b = jnp.asarray(rng.normal(size=(v,)).astype(np.float32))
+    labels = jnp.asarray(rng.integers(0, v, size=(n,)).astype(np.int32))
+    labels = labels.at[3].set(v + 5)  # out-of-range: loss = lse row
+
+    lr, gr = jax.value_and_grad(
+        lambda x, w, b: linear_cross_entropy(x, w, labels, b),
+        argnums=(0, 1, 2),
+    )(x, w, b)
+
+    def check(fn):
+        ls, gs = jax.value_and_grad(fn, argnums=(0, 1, 2))(x, w, b)
+        np.testing.assert_allclose(float(ls), float(lr), rtol=1e-6)
+        for got, want, nm in zip(gs, gr, ("dx", "dw", "db")):
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-6,
+                err_msg=nm,
+            )
+
+    # TP: x replicated, vocab sharded over "model".
+    tp = make_mesh(MeshConfig({"model": 4}), jax.devices()[:4])
+
+    def tp_loss(x, w, b):
+        def body(x, w, b, ln):
+            return sharded_linear_cross_entropy(
+                x, w, ln, b, axis_name="model", interpret=True,
+                save_s=save_s,
+            )
+        return shard_map_fn(
+            body, tp,
+            in_specs=(P(), P(None, "model"), P("model"), P()),
+            out_specs=P(),
+        )(x, w, b, labels)
+
+    check(tp_loss)
+
+    # 1-D FSDP: tokens AND vocab share "data"; gather the batch first.
+    fs = make_mesh(MeshConfig({"data": 4}), jax.devices()[:4])
+
+    def fs_loss(x, w, b):
+        def body(x, w, b, ln):
+            xg = jax.lax.all_gather(x, "data", axis=0, tiled=True)
+            lg = jax.lax.all_gather(ln, "data", axis=0, tiled=True)
+            return sharded_linear_cross_entropy(
+                xg, w, lg, b, axis_name="data", interpret=True,
+                save_s=save_s,
+            )
+        return shard_map_fn(
+            body, fs,
+            in_specs=(P("data"), P(None, "data"), P("data"), P("data")),
+            out_specs=P(),
+        )(x, w, b, labels)
+
+    check(fs_loss)
+
+    # 2-D FSDP×TP: tokens over "data", vocab over "model", W dim 0
+    # gathered over "data" on use, per-shard token means pmean'd.
+    ft = make_mesh(MeshConfig({"data": 2, "model": 2}), jax.devices()[:4])
+
+    def ft_loss(x, w, b):
+        def body(x, w, b, ln):
+            k = jax.lax.all_gather(w, "data", axis=0, tiled=True)
+            loss = sharded_linear_cross_entropy(
+                x, k, ln, b, axis_name="model", interpret=True,
+                save_s=save_s,
+            )
+            return jax.lax.pmean(loss, "data")
+        return shard_map_fn(
+            body, ft,
+            in_specs=(P("data"), P("data", "model"), P("model"), P("data")),
+            out_specs=P(),
+        )(x, w, b, labels)
+
+    check(ft_loss)
+
+
 # ------------------------------------------------------- pipeline × fused
 
 
@@ -220,6 +406,10 @@ def test_task5_accepts_fused_flags_multichip():
     assert np.isfinite(out["final_loss"])
     out = main(base + ["--parallel", "pp", "--fused_ln",
                        "--microbatches", "2"])
+    assert np.isfinite(out["final_loss"])
+    out = main(base + ["--parallel", "tp", "--fused_xent"])
+    assert np.isfinite(out["final_loss"])
+    out = main(base + ["--parallel", "fsdp", "--fused_xent"])
     assert np.isfinite(out["final_loss"])
 
 
@@ -297,10 +487,13 @@ def test_save_scores_requires_fused_xent():
         )
 
 
-def test_task5_fused_xent_rejects_sharded_head_engines():
+def test_task5_fused_xent_rejects_pp_only():
+    """pp is the one remaining non-composition: the pipeline epilogue
+    ships logits between stages, so there is no feature tensor for the
+    fused head to consume. tp/fsdp now build (covered above)."""
     from tasks.task5_longcontext import build_engine, parse_args
 
-    args = parse_args(["--parallel", "tp", "--fused_xent"])
+    args = parse_args(["--parallel", "pp", "--fused_xent"])
     with pytest.raises(ValueError, match="fused_xent"):
         build_engine(args, jax.devices()[:2])
 
@@ -350,6 +543,54 @@ def test_save_s_auto_threshold():
     assert _auto_save_s(131072, 32768, bn, bv) is False  # long-context
     # Padding counts: n=1 still pads to a block row multiple of 8.
     assert _auto_save_s(1, 256, bn, bv) is True
+
+
+def test_save_s_auto_threshold_sharded(monkeypatch):
+    """The sharded head resolves save_s=None against its LOCAL vocab —
+    each shard holds a 1/W slice of the score residual, so a 16k×32k
+    problem that is lean unsharded (2 GiB + one padded block row) flips
+    to speed mode once 4 shards each hold 16k×8k (512 MiB). Pinned at
+    the exact byte boundary, and the wiring is pinned by recording the
+    (n, v) the public entry point hands to the auto rule."""
+    from jax.sharding import PartitionSpec as P
+
+    from tpudml.ops import xent_kernel as xk
+    from tpudml.parallel.sharding import shard_map_fn
+
+    bn, bv = 256, 2048
+    n, v, shards = 16640, 32768, 4
+    # Unsharded: one padded block row past the 2 GiB budget.
+    assert xk._auto_save_s(n, v, bn, bv) is False
+    _, _, n_pad, v_pad = xk._padded_dims(n, v, bn, bv)
+    assert (n_pad - bn) * v_pad * 4 == xk.SAVE_S_AUTO_MAX_BYTES
+    # Each shard's residual is exactly 1/W of that -> back under budget.
+    assert xk._auto_save_s(n, v // shards, bn, bv) is True
+
+    # And sharded_linear_cross_entropy really uses the local slice.
+    seen = []
+    real = xk._auto_save_s
+
+    def spy(n, v, block_n, block_v):
+        seen.append((n, v))
+        return real(n, v, block_n, block_v)
+
+    monkeypatch.setattr(xk, "_auto_save_s", spy)
+    mesh = make_mesh(MeshConfig({"model": 4}), jax.devices()[:4])
+    nn, d, vv = 8, 4, 32
+    x = jnp.zeros((nn, d), jnp.float32)
+    w = jnp.zeros((d, vv), jnp.float32)
+    labels = jnp.zeros((nn,), jnp.int32)
+
+    def body(x, w, ln):
+        return xk.sharded_linear_cross_entropy(
+            x, w, ln, axis_name="model", save_s=None
+        )
+
+    shard_map_fn(
+        body, mesh,
+        in_specs=(P(), P(None, "model"), P()), out_specs=P(),
+    )(x, w, labels)
+    assert (nn, vv // 4) in seen
 
 
 def test_pick_bv_dw_divisor_contract():

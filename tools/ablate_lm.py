@@ -31,13 +31,14 @@ from tpudml.train import TrainState
 
 
 def run(name, batch=8, seq_len=1024, vocab=32768, heads=8, layers=6,
-        dim=512, impl="flash", remat=False, fused_ln=False, fused_xent=False):
+        dim=512, impl="flash", remat=False, fused_ln=False, fused_xent=False,
+        opt_name="adamw"):
     model = TransformerLM(
         vocab_size=vocab, embed_dim=dim, num_heads=heads, num_layers=layers,
         max_len=seq_len, impl=impl, rope=True, remat=remat,
         compute_dtype=jnp.bfloat16, fused_ln=fused_ln,
     )
-    opt = make_optimizer("adamw", 3e-4)
+    opt = make_optimizer(opt_name, 3e-4)
     # synthetic_lm returns [n, seq_len+1] already; x/y slices give T=seq_len.
     seqs = jnp.asarray(synthetic_lm(batch, seq_len, vocab, seed=1))
     x, y = seqs[:, :-1], seqs[:, 1:]
@@ -73,8 +74,84 @@ def run(name, batch=8, seq_len=1024, vocab=32768, heads=8, layers=6,
     return sec
 
 
+from contextlib import contextmanager  # noqa: E402
+
+
+@contextmanager
+def _patched(obj, name, repl):
+    orig = getattr(obj, name)
+    setattr(obj, name, repl)
+    try:
+        yield
+    finally:
+        setattr(obj, name, orig)
+
+
+def budget(**cfg):
+    """Per-component budget table for the flagship fused step (the 19.3 ms
+    config: heads=4, fused_ln, fused-xent save-s, AdamW).
+
+    Each arm removes ONE component — by monkeypatch-to-identity (the r3
+    LN-ablation idiom) or config ablation — and its delta to the full step
+    prices that component in ONE process, so relay-state drift between
+    rounds differences out. Caveats per arm: the head arm (V=512) also
+    shrinks the V-scaled part of the embedding backward, and the
+    junction arm keeps the residual adds and the scale/bias affine (the
+    delta prices the normalization + fusion structure, not the adds).
+    Residual = total − Σ components (QKV/FFN matmuls + dispatch)."""
+    import tpudml.ops as ops
+    from tpudml.models import transformer as tr
+    from tpudml.ops import layernorm_kernel as lnk
+
+    base = dict(heads=4, fused_ln=True, fused_xent=True)
+    base.update(cfg)
+
+    def attn_identity(q, k, v, *, causal=True, **kw):
+        return v
+
+    def junction_identity(x, r, scale, bias, *, eps=1e-5, block_n=256,
+                          interpret=None):
+        s = x + r
+        return s, s * scale + bias  # params stay live, no moments
+
+    def embed_row0(table, tokens):
+        return jnp.broadcast_to(
+            table[0], (*tokens.shape, table.shape[-1]))
+
+    total = run("flagship fused (total)", **base)
+    rows = []
+    with _patched(ops, "flash_attention", attn_identity):
+        rows.append(("attention", run("  - attention -> identity", **base)))
+    with _patched(lnk, "fused_add_layernorm", junction_identity):
+        rows.append(("junctions", run("  - junctions -> add+affine", **base)))
+    # Proportional vocab shrink (flagship 32k -> 512, the r2/r3 arm).
+    tiny_v = max(8, base.get("vocab", 32768) // 64)
+    rows.append(("head", run(f"  - head (V={tiny_v})",
+                             **{**base, "vocab": tiny_v})))
+    with _patched(tr, "embed_lookup", embed_row0):
+        rows.append(("embed", run("  - embed -> row-0 broadcast", **base)))
+    rows.append(("adamw", run("  - AdamW -> SGD",
+                              **{**base, "opt_name": "sgd"})))
+
+    print("\ncomponent budget (full - ablated):")
+    accounted = 0.0
+    for name, sec in rows:
+        delta = total - sec
+        accounted += delta
+        print(f"  {name:10s} {delta*1e3:7.2f} ms  "
+              f"({delta / total * 100:5.1f}% of step)")
+    resid = total - accounted
+    print(f"  {'residual':10s} {resid*1e3:7.2f} ms  "
+          f"({resid / total * 100:5.1f}% of step)  "
+          f"[QKV/FFN matmuls + dispatch]")
+    return total, dict(rows)
+
+
 if __name__ == "__main__":
     which = sys.argv[1:] or ["base", "tinyvocab", "fullattn", "b32", "h4"]
+    if "budget" in which:
+        budget()
+        which = [w for w in which if w != "budget"]
     if "base" in which:
         run("base 6L512d V32k B8 flash")
     if "tinyvocab" in which:

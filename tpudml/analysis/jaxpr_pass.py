@@ -18,7 +18,13 @@ multi-host slice:
 - J105  large (>1 MiB) arrays captured as jaxpr constants — baked into
         the program instead of passed (and donated) as arguments;
 - J106  (from the lowered module, not the jaxpr) steps whose large
-        inputs carry no donation aliasing at all.
+        inputs carry no donation aliasing at all;
+- J107  the UNSHARDED fused cross-entropy head consuming a kernel whose
+        vocab (last) dimension is sharded over a mesh axis — each shard
+        then normalizes over only its local vocab slice and the losses
+        are silently wrong; the sharded wrapper
+        (``sharded_linear_cross_entropy``) merges per-shard statistics
+        and stays silent.
 
 The pass is backend-free: everything works on abstract values on CPU.
 """
@@ -56,6 +62,18 @@ ACCUM_OK_PRIMS = frozenset({
 })
 
 LARGE_CONST_BYTES = 1 << 20  # 1 MiB
+
+# The fused cross-entropy dispatchers are jitted under marker names that
+# survive as pjit ``name`` params in any traced jaxpr (J107). Mirrors
+# FUSED_XENT_MARKER / SHARDED_XENT_MARKER in tpudml/ops/xent_kernel.py —
+# string literals here so the analyzer never imports kernel code; the
+# pairing is pinned by test_analysis.
+FUSED_XENT_NAME = "_fused_xent_unsharded"
+SHARDED_XENT_NAME = "_fused_xent_sharded"
+
+# Primitives a last-dim sharding survives on the way from a shard_map
+# body invar to the fused head's w operand (J107 taint propagation).
+_LASTDIM_PRESERVING = frozenset({"convert_element_type", "copy"})
 
 
 def _repo_rel(path: str) -> str:
@@ -181,6 +199,77 @@ def _check_upcasts(jaxpr, entrypoint: str, findings: list[Finding]) -> None:
             ))
 
 
+def _fused_xent_seed(eqn) -> dict[int, tuple[str, ...]]:
+    """J107 taint seed for one shard_map equation: body invars whose
+    LAST dimension the in_names shard, mapped to the sharding axes."""
+    in_names = eqn.params.get("in_names")
+    body = eqn.params.get("jaxpr")
+    if in_names is None or body is None:
+        return {}
+    jaxpr, _ = _inner_jaxpr(body)
+    tainted: dict[int, tuple[str, ...]] = {}
+    for var, names in zip(jaxpr.invars, in_names):
+        ndim = getattr(getattr(var, "aval", None), "ndim", 0)
+        axes = names.get(ndim - 1, ()) if ndim else ()
+        if axes:
+            tainted[id(var)] = tuple(str(a) for a in axes)
+    return tainted
+
+
+def _check_fused_xent(obj, tainted: dict[int, tuple[str, ...]],
+                      entrypoint: str, findings: list[Finding]) -> None:
+    """J107 within a shard_map body: propagate 'vocab dim is sharded'
+    from the seed through last-dim-preserving ops (and all_gathers over
+    other dims) to the w operand (position 1) of a pjit carrying the
+    unsharded fused-xent marker name. The sharded dispatcher's distinct
+    marker keeps correct compositions silent."""
+    jaxpr, _ = _inner_jaxpr(obj)
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name == "pjit":
+            jit_name = str(eqn.params.get("name", ""))
+            if jit_name == FUSED_XENT_NAME:
+                axes = (tainted.get(id(eqn.invars[1]))
+                        if len(eqn.invars) > 1 else None)
+                if axes:
+                    f, ln = _src_loc(eqn)
+                    findings.append(Finding(
+                        "J107",
+                        f"fused cross-entropy head consumes a kernel whose "
+                        f"vocab (last) dim is sharded over mesh axis "
+                        f"{list(axes)} without the shard-merge wrapper — "
+                        f"each shard normalizes over its local slice only; "
+                        f"use sharded_linear_cross_entropy(axis_name=...)",
+                        file=f, line=ln, entrypoint=entrypoint,
+                    ))
+                continue
+            if jit_name == SHARDED_XENT_NAME:
+                continue  # merge wrapper present — correct by construction
+            sub = eqn.params.get("jaxpr")
+            if sub is not None:
+                sj, _ = _inner_jaxpr(sub)
+                inner = {
+                    id(sj.invars[i]): axes
+                    for i, v in enumerate(eqn.invars)
+                    if (axes := tainted.get(id(v))) and i < len(sj.invars)
+                }
+                if inner:
+                    _check_fused_xent(sub, inner, entrypoint, findings)
+            continue
+        if not eqn.invars or not eqn.outvars:
+            continue
+        axes = tainted.get(id(eqn.invars[0]))
+        if not axes:
+            continue
+        if name in _LASTDIM_PRESERVING:
+            tainted[id(eqn.outvars[0])] = axes
+        elif name == "all_gather":
+            out = eqn.outvars[0]
+            ndim = getattr(getattr(out, "aval", None), "ndim", 0)
+            if eqn.params.get("all_gather_dimension", 0) != ndim - 1:
+                tainted[id(out)] = axes
+
+
 def _walk(obj, bound: frozenset[str], entrypoint: str,
           findings: list[Finding]) -> None:
     jaxpr, consts = _inner_jaxpr(obj)
@@ -224,6 +313,11 @@ def _walk(obj, bound: frozenset[str], entrypoint: str,
                     f"sequences — {desc}",
                     file=f, line=ln, entrypoint=entrypoint,
                 ))
+        if name == "shard_map":
+            seed = _fused_xent_seed(eqn)
+            if seed:
+                _check_fused_xent(eqn.params["jaxpr"], seed, entrypoint,
+                                  findings)
         for sub, extra in _sub_jaxprs(eqn):
             _walk(sub, bound | extra, entrypoint, findings)
 
@@ -244,7 +338,8 @@ def _check_consts(consts, entrypoint: str, findings: list[Finding]) -> None:
 
 
 def analyze_closed_jaxpr(closed, entrypoint: str = "") -> list[Finding]:
-    """All jaxpr-level findings (J101-J105) for one traced program."""
+    """All jaxpr-level findings (J101-J105, J107) for one traced
+    program."""
     findings: list[Finding] = []
     _walk(closed, frozenset(), entrypoint, findings)
     return findings

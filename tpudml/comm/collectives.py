@@ -40,6 +40,31 @@ def axis_size(axis_name: str) -> int:
     return lax.psum(1, axis_name)
 
 
+def pmax_tree(tree: PyTree, axis_name: str) -> PyTree:
+    """AllReduce-MAX over every leaf — the merge collective for online
+    statistics (running softmax maxima, lse merges)."""
+    return jax.tree.map(lambda x: lax.pmax(x, axis_name), tree)
+
+
+def plogsumexp(x: jax.Array, axis_name: str) -> jax.Array:
+    """Cross-shard log-sum-exp merge: each shard holds a partial
+    ``lse_local = log Σ_local exp(s)`` over its slice of a reduced axis;
+    the global lse is their logsumexp over the mesh axis. This is the
+    SAME online combination rule the ring-attention fold uses per
+    arriving block (tpudml/parallel/cp.py ``_merge_blocks``), expressed
+    as one pmax + one psum — the shift makes the psum overflow-safe, and
+    lse's shift-invariance makes ``stop_gradient`` on the shift exact:
+    d lse/d lse_local = exp(lse_local − lse), the correct softmax slice
+    weight, flows entirely through the psum term. Differentiable; used
+    by the vocab-sharded fused cross-entropy head to merge per-shard
+    partial-vocab statistics."""
+    # stop_gradient on the INPUT, not the result: pmax has no JVP rule
+    # on the pinned jax, and with a symbolic-zero tangent the primitive
+    # is never differentiated at all.
+    m = lax.pmax(lax.stop_gradient(x), axis_name)
+    return m + jnp.log(lax.psum(jnp.exp(x - m), axis_name))
+
+
 def psum_tree(tree: PyTree, axis_name: str) -> PyTree:
     """AllReduce-SUM over every leaf of a pytree (one traced program)."""
     return jax.tree.map(lambda x: lax.psum(x, axis_name), tree)

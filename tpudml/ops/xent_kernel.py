@@ -507,6 +507,48 @@ def _auto_save_s(n: int, v: int, block_n: int, block_v: int) -> bool:
     return n_pad * v_pad * 4 <= SAVE_S_AUTO_MAX_BYTES
 
 
+def _reference_xent(xn, w, b, ln):
+    """Differentiable XLA reference with the SAME out-of-range-label
+    semantics as the kernel (loss = lse, no pull-up) —
+    ``softmax_cross_entropy`` would CLAMP invalid ids to an edge class,
+    silently training differently per backend."""
+    v = w.shape[-1]
+    logits = (xn @ w + b).astype(jnp.float32)
+    m = jnp.max(logits, axis=-1)
+    lse = m + jnp.log(jnp.sum(jnp.exp(logits - m[:, None]), axis=-1))
+    ids = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+    picked = jnp.sum(
+        jnp.where(ids == ln[:, None].astype(jnp.int32), logits, 0.0),
+        axis=-1,
+    )
+    valid = (ln >= 0) & (ln < v)
+    return jnp.mean(lse - jnp.where(valid, picked, 0.0))
+
+
+# The unsharded dispatch runs inside a NAMED nested jit so the call
+# survives as a recognizably-named pjit equation in any traced step —
+# the marker tpudml.analysis rule J107 keys on to flag a full-vocab
+# fused-xent call whose W operand is actually vocab-sharded on a mesh
+# axis (a partial-vocab softmax that trains wrong silently). The
+# sharded wrapper below carries a DIFFERENT name, so the correct
+# composition stays silent. XLA inlines inner jits at lowering, so the
+# marker costs nothing on the chip.
+def _fused_xent_unsharded(x, w, b, labels, block_n, block_v, interpret,
+                          save_s):
+    if interpret is None:
+        if jax.default_backend() != "tpu":
+            return _reference_xent(x, w, b, labels)
+        interpret = False
+    return _fused(x, w, b, labels, block_n, block_v, interpret, save_s)
+
+
+FUSED_XENT_MARKER = _fused_xent_unsharded.__name__
+
+_fused_xent_unsharded_jit = jax.jit(
+    _fused_xent_unsharded, static_argnums=(4, 5, 6, 7)
+)
+
+
 def linear_cross_entropy(
     x: jax.Array,
     w: jax.Array,
@@ -533,7 +575,13 @@ def linear_cross_entropy(
     mode beyond (the long-context regimes the memory contract exists
     for). Pass ``False`` to force the O(N) contract regardless. On
     non-TPU backends dispatches to the XLA reference math unless
-    ``interpret=True`` forces the Pallas interpreter."""
+    ``interpret=True`` forces the Pallas interpreter.
+
+    ``w`` here is the FULL vocab projection. When the head is
+    vocab-sharded over a mesh axis, use
+    :func:`sharded_linear_cross_entropy` inside the ``shard_map``
+    region instead — feeding a vocab shard to this function computes a
+    partial-vocab softmax (rule J107 flags exactly that)."""
     d = x.shape[-1]
     v = w.shape[-1]
     xn = x.reshape(-1, d)
@@ -542,27 +590,175 @@ def linear_cross_entropy(
         raise ValueError(f"{x.shape} rows != {labels.shape} labels")
     if save_s is None:
         save_s = _auto_save_s(xn.shape[0], v, block_n, block_v)
+    b = jnp.zeros((v,), w.dtype) if bias is None else bias
+    return _fused_xent_unsharded_jit(
+        xn, w, b, ln, block_n, block_v, interpret, save_s
+    )
+
+
+# ------------------------------------------------- vocab-sharded variant
+# The distributed form of the fused head: each shard of a vocab-sharded
+# W ([d, V/W] per chip) streams only its local tiles through the SAME
+# Pallas kernels above and emits per-shard partial statistics
+# (lse_local, picked_local); shards merge with the online log-sum-exp
+# combination rule ring attention uses per arriving K/V block
+# (tpudml/parallel/cp.py _merge_blocks), here one pmax + one psum over
+# the mesh axis (collectives.plogsumexp). Label semantics do the shard
+# routing for free: shifting labels by -shard·V_local makes out-of-shard
+# labels out-of-range, which the kernel already maps to picked = 0 — so
+# psum(picked_local) recovers the one true pick with no gather.
+#
+# Backward: p = exp(s_local − lse_GLOBAL) is exactly this shard's slice
+# of the global softmax, so the existing backward kernels run unchanged
+# with the merged lse as input — dW/db stay 1/W shard-local with NO
+# extra collective, and dX comes back as a per-shard partial that the
+# enclosing shard_map transpose psums once (W's axis is mentioned in
+# its in_spec, x's is not: the single dX reduce is derived, not coded).
+# The custom_vjp therefore returns dX UN-summed — summing here too
+# would double-count by the axis size.
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _fused_sharded(x, w, b, labels, axis_name, block_n, block_v, interpret,
+                   save_s):
+    loss, _ = _fused_sharded_fwd(
+        x, w, b, labels, axis_name, block_n, block_v, interpret, save_s
+    )
+    return loss
+
+
+def _fused_sharded_fwd(x, w, b, labels, axis_name, block_n, block_v,
+                       interpret, save_s):
+    from tpudml.comm.collectives import plogsumexp
+
+    v_local = w.shape[-1]
+    shard = jax.lax.axis_index(axis_name)
+    ln = labels.astype(jnp.int32) - shard * v_local
+    s = None
+    if save_s:
+        lse_loc, picked_loc, s = _fused_forward(
+            x, w, b, ln, block_n, block_v, interpret, save_s=True
+        )
+    else:
+        lse_loc, picked_loc = _fused_forward(
+            x, w, b, ln, block_n, block_v, interpret
+        )
+    lse = plogsumexp(lse_loc, axis_name)
+    picked = jax.lax.psum(picked_loc, axis_name)
+    return jnp.mean(lse - picked), (x, w, b, ln, lse, s)
+
+
+def _fused_sharded_bwd(axis_name, block_n, block_v, interpret, save_s,
+                       res, g):
+    import numpy as np
+
+    x, w, b, ln, lse, s = res
+    # shard_map (check_rep=False) transposition convention: the
+    # cotangent of an output whose spec does not mention an axis arrives
+    # DIVIDED by that axis size, and body psums transpose to psums —
+    # that is how the pure-autodiff reference path regains the factor
+    # through the merge collectives' transposes. This custom_vjp
+    # replaces those transposes, so it must restore the factor itself:
+    # psum of the (replicated) cotangent over the merge axis. Verified
+    # by the TP/FSDP/FSDP×TP interpret-mode parity tests — dropping
+    # this psum deflates every gradient by exactly the axis size.
+    g = jax.lax.psum(g, axis_name)
+    if save_s:
+        dx, dw, db = _fused_backward_saved(
+            x, w, b, ln, lse, s, g, block_n, block_v, interpret
+        )
+    else:
+        dx, dw, db = _fused_backward(
+            x, w, b, ln, lse, g, block_n, block_v, interpret
+        )
+    # dx is this shard's PARTIAL over its vocab slice — the shard_map
+    # transpose supplies the one cross-shard reduce (see block comment).
+    return dx, dw, db, np.zeros(ln.shape, dtype=jax.dtypes.float0)
+
+
+_fused_sharded.defvjp(_fused_sharded_fwd, _fused_sharded_bwd)
+
+
+def _sharded_reference(xn, w, b, ln, axis_name):
+    """Differentiable sharded XLA reference (non-TPU dispatch): local
+    partial-vocab statistics merged with the identical plogsumexp/psum
+    rule. Grad-exact vs the unsharded reference by construction —
+    autodiff of the merge reproduces p = exp(s − lse_global) per
+    shard."""
+    from tpudml.comm.collectives import plogsumexp
+
+    v_local = w.shape[-1]
+    shard = jax.lax.axis_index(axis_name)
+    ln = ln.astype(jnp.int32) - shard * v_local
+    logits = (xn @ w + b).astype(jnp.float32)
+    m = jnp.max(logits, axis=-1)
+    lse_loc = m + jnp.log(jnp.sum(jnp.exp(logits - m[:, None]), axis=-1))
+    lse = plogsumexp(lse_loc, axis_name)
+    ids = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+    picked_loc = jnp.sum(
+        jnp.where(ids == ln[:, None], logits, 0.0), axis=-1
+    )
+    valid = (ln >= 0) & (ln < v_local)
+    picked = jax.lax.psum(jnp.where(valid, picked_loc, 0.0), axis_name)
+    return jnp.mean(lse - picked)
+
+
+# Named marker for the CORRECT sharded composition — distinct from
+# FUSED_XENT_MARKER, so J107 stays silent on it.
+def _fused_xent_sharded(x, w, b, labels, axis_name, block_n, block_v,
+                        interpret, save_s):
     if interpret is None:
         if jax.default_backend() != "tpu":
-            # XLA fallback with the SAME out-of-range-label semantics as
-            # the kernel (loss = lse, no pull-up) — softmax_cross_entropy
-            # would CLAMP invalid ids to an edge class, silently training
-            # differently per backend.
-            logits = xn @ w
-            if bias is not None:
-                logits = logits + bias
-            logits = logits.astype(jnp.float32)
-            m = jnp.max(logits, axis=-1)
-            lse = m + jnp.log(
-                jnp.sum(jnp.exp(logits - m[:, None]), axis=-1)
-            )
-            ids = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
-            picked = jnp.sum(
-                jnp.where(ids == ln[:, None].astype(jnp.int32), logits, 0.0),
-                axis=-1,
-            )
-            valid = (ln >= 0) & (ln < v)
-            return jnp.mean(lse - jnp.where(valid, picked, 0.0))
+            return _sharded_reference(x, w, b, labels, axis_name)
         interpret = False
-    b = jnp.zeros((v,), w.dtype) if bias is None else bias
-    return _fused(xn, w, b, ln, block_n, block_v, interpret, save_s)
+    return _fused_sharded(
+        x, w, b, labels, axis_name, block_n, block_v, interpret, save_s
+    )
+
+
+SHARDED_XENT_MARKER = _fused_xent_sharded.__name__
+
+_fused_xent_sharded_jit = jax.jit(
+    _fused_xent_sharded, static_argnums=(4, 5, 6, 7, 8)
+)
+
+
+def sharded_linear_cross_entropy(
+    x: jax.Array,
+    w: jax.Array,
+    labels: jax.Array,
+    bias: jax.Array | None = None,
+    *,
+    axis_name: str,
+    block_n: int = 256,
+    block_v: int = 2048,
+    interpret: bool | None = None,
+    save_s: bool | None = None,
+) -> jax.Array:
+    """Vocab-sharded :func:`linear_cross_entropy`: call INSIDE a
+    ``shard_map`` region where ``axis_name`` is bound, with ``w`` the
+    LOCAL [d, V/W] vocab shard (``bias`` its [V/W] slice) and ``labels``
+    GLOBAL ids; every shard must hold the same ``x`` rows. Returns the
+    replicated global mean loss — identical to the unsharded call on
+    the concatenated W, to float tolerance (pinned by parity tests under
+    TP, FSDP, and FSDP×TP meshes).
+
+    ``save_s=None`` auto-resolves against the LOCAL vocab: the f32
+    score residual is N_pad·(V/W)_pad·4 bytes PER SHARD — 1/W of the
+    unsharded residual — so sharding widens the regime where the speed
+    mode fits ``SAVE_S_AUTO_MAX_BYTES``. Gradient contract: dW/db are
+    shard-local (1/W per chip, no collective); dX is returned as a
+    per-shard partial for the enclosing shard_map transpose to reduce
+    once."""
+    d = x.shape[-1]
+    v_local = w.shape[-1]
+    xn = x.reshape(-1, d)
+    ln = labels.reshape(-1)
+    if xn.shape[0] != ln.shape[0]:
+        raise ValueError(f"{x.shape} rows != {labels.shape} labels")
+    if save_s is None:
+        save_s = _auto_save_s(xn.shape[0], v_local, block_n, block_v)
+    b = jnp.zeros((v_local,), w.dtype) if bias is None else bias
+    return _fused_xent_sharded_jit(
+        xn, w, b, ln, axis_name, block_n, block_v, interpret, save_s
+    )

@@ -112,6 +112,8 @@ class FSDP(GSPMDParallel):
         accum_steps: int = 1,
         loss: Callable = softmax_cross_entropy,
         aux_loss_weight: float | None = None,
+        fused_xent: bool = False,
+        save_scores: bool | None = None,
     ):
         if axis_name not in mesh.shape:
             raise ValueError(
@@ -130,4 +132,6 @@ class FSDP(GSPMDParallel):
             accum_steps=accum_steps,
             loss=loss,
             aux_loss_weight=aux_loss_weight,
+            fused_xent=fused_xent,
+            save_scores=save_scores,
         )

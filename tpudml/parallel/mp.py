@@ -177,7 +177,16 @@ class GSPMDParallel:
         accum_steps: int = 1,
         loss: Callable = softmax_cross_entropy,
         aux_loss_weight: float | None = None,
+        fused_xent: bool = False,
+        save_scores: bool | None = None,
     ):
+        if save_scores and not fused_xent:
+            raise ValueError("save_scores requires fused_xent=True")
+        if fused_xent and (accum_steps != 1 or loss is not softmax_cross_entropy):
+            raise ValueError(
+                "fused_xent composes with the fused LM step and the built-in "
+                "cross-entropy only (no accum_steps, no custom loss)"
+            )
         self.model = model
         self.optimizer = optimizer
         self.mesh = mesh
@@ -199,6 +208,9 @@ class GSPMDParallel:
         self._loss_fn = make_loss_fn(
             model, loss, resolve_aux_loss_weight(model, aux_loss_weight)
         )
+        self.fused_xent = fused_xent
+        self.save_scores = save_scores
+        self._aux_loss_weight = aux_loss_weight
         self._specs = None  # computed at create_state
         self._throttle = DispatchThrottle(mesh)
 
@@ -240,14 +252,42 @@ class GSPMDParallel:
         state_shardings = self._shardings(self._specs)
         batch_sharding = NamedSharding(self.mesh, batch_spec)
 
+        fused_loss_fn = None
+        if self.fused_xent:
+            # Built lazily HERE (not __init__): the sharded loss derives
+            # its shard_map region from the head kernel's placed spec,
+            # which exists only after create_state ran apply_rules.
+            spec_params = self._specs.params
+            if not isinstance(spec_params, dict) or "head" not in spec_params:
+                raise ValueError(
+                    "fused_xent needs a model with a 'head' Dense subtree "
+                    "and apply_features (TransformerLM)"
+                )
+            from tpudml.train import make_lm_fused_sharded_loss_fn
+
+            fused_loss_fn = make_lm_fused_sharded_loss_fn(
+                self.model,
+                self.mesh,
+                kernel_spec=spec_params["head"]["kernel"],
+                batch_axis=self.batch_axis,
+                save_scores=self.save_scores,
+                aux_loss_weight=self._aux_loss_weight,
+            )
+
         def step_impl(ts: TrainState, images, labels):
             rng = None
             if self.rng_root is not None:
                 rng = jax.random.fold_in(self.rng_root, ts.step)
-            grads, model_state, metrics = accumulate_grads(
-                self._loss_fn, ts.params, ts.model_state, images, labels, rng,
-                self.accum_steps,
-            )
+            if fused_loss_fn is not None:
+                (loss, model_state), grads = jax.value_and_grad(
+                    fused_loss_fn, has_aux=True
+                )(ts.params, ts.model_state, images, labels, rng)
+                metrics = {"loss": loss}
+            else:
+                grads, model_state, metrics = accumulate_grads(
+                    self._loss_fn, ts.params, ts.model_state, images, labels,
+                    rng, self.accum_steps,
+                )
             new_params, new_opt = self.optimizer.update(grads, ts.opt_state, ts.params)
             new_ts = TrainState(
                 params=new_params,

@@ -227,6 +227,109 @@ def make_lm_fused_loss_fn(
     return loss_fn
 
 
+def make_lm_fused_sharded_loss_fn(
+    model: Module,
+    mesh: Any,
+    kernel_spec: Any,
+    batch_axis: str | None = None,
+    save_scores: bool | None = None,
+    aux_loss_weight: float | None = None,
+) -> Callable:
+    """(params, model_state, tokens, labels[, rng]) -> (loss, new_state)
+    through the fused head when the head itself is SHARDED — the GSPMD
+    engines' (TP / FSDP / FSDP×TP) ``fused_xent`` path.
+
+    The trunk stays GSPMD-auto-partitioned; only the head runs inside an
+    explicit ``shard_map`` region (the Pallas kernel is opaque to the
+    SPMD partitioner, and the cross-shard lse merge is manual math).
+    ``kernel_spec`` is the head kernel's [d, V] PartitionSpec from the
+    engine's placement; the region derives everything from it:
+
+    - dim 1 names the VOCAB axis → per-shard partial statistics merged
+      by ``sharded_linear_cross_entropy`` (one pmax + two psums); a
+      demoted (replicated) dim 1 falls back to the plain kernel call.
+    - dim 0 sharded (FSDP×TP puts ``data`` there) → W is all-gathered
+      on use, and the gather's transpose delivers dW as the ZeRO
+      reduce-scatter — exactly FSDP's gradient layout, derived not coded.
+    - vocab axis == batch axis (1-D FSDP: ``data`` does double duty) →
+      tokens+labels are all-gathered over the batch axis first, so every
+      shard scores ALL tokens against its vocab slice; the gather's
+      transpose (psum_scatter) routes the partial dX back to token
+      shards with the single reduce the math needs.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from tpudml.ops.xent_kernel import (
+        linear_cross_entropy,
+        sharded_linear_cross_entropy,
+    )
+    from tpudml.parallel.sharding import shard_map_fn
+
+    aux_w = resolve_aux_loss_weight(model, aux_loss_weight)
+
+    def _axes(entry):
+        if entry is None:
+            return ()
+        return tuple(entry) if isinstance(entry, tuple) else (entry,)
+
+    kspec = tuple(kernel_spec)
+    kspec = kspec + (None,) * (2 - len(kspec))  # P drops trailing Nones
+    d0_axes, v_axes = _axes(kspec[0]), _axes(kspec[1])
+    if len(v_axes) > 1:
+        raise ValueError(
+            f"head kernel vocab dim sharded over {v_axes}: the partial-"
+            "stat merge runs over ONE mesh axis"
+        )
+    vocab_axis = v_axes[0] if v_axes else None
+    # 1-D FSDP shards tokens AND vocab over the same axis; merging
+    # partial stats across shards holding DIFFERENT tokens would be
+    # wrong, so the batch gathers first (see docstring).
+    gather_batch = batch_axis is not None and batch_axis == vocab_axis
+    batch_spec = P(batch_axis) if batch_axis else P()
+
+    def head_loss(feats, kernel, bias, labels):
+        xn = feats.reshape(-1, feats.shape[-1])
+        ln = labels.reshape(-1)
+        if gather_batch:
+            xn = jax.lax.all_gather(xn, batch_axis, axis=0, tiled=True)
+            ln = jax.lax.all_gather(ln, batch_axis, axis=0, tiled=True)
+        k = kernel
+        for ax in d0_axes:
+            k = jax.lax.all_gather(k, ax, axis=0, tiled=True)
+        if vocab_axis is not None:
+            loss = sharded_linear_cross_entropy(
+                xn, k, ln, bias, axis_name=vocab_axis, save_s=save_scores
+            )
+        else:
+            loss = linear_cross_entropy(xn, k, ln, bias, save_s=save_scores)
+        if batch_axis and not gather_batch:
+            # Per-shard token-mean → global token mean (equal shards).
+            loss = jax.lax.pmean(loss, batch_axis)
+        return loss
+
+    sharded_head = shard_map_fn(
+        head_loss,
+        mesh,
+        in_specs=(batch_spec, P(*kspec), P(kspec[1]), batch_spec),
+        out_specs=P(),
+    )
+
+    def loss_fn(params, model_state, tokens, labels, rng=None):
+        feats, new_state = model.apply_features(
+            params, model_state, tokens, train=True, rng=rng
+        )
+        head = model._cast_params(params)["head"]
+        bias = head.get("bias")
+        if bias is None:
+            bias = jnp.zeros((head["kernel"].shape[-1],), head["kernel"].dtype)
+        loss = sharded_head(feats, head["kernel"], bias, labels)
+        if aux_w:
+            loss = loss + aux_w * collect_aux_losses(new_state)
+        return loss, new_state
+
+    return loss_fn
+
+
 def make_lm_fused_train_step_body(
     model: Module,
     optimizer: Optimizer,
