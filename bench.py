@@ -396,6 +396,146 @@ def bench_transformer(on_tpu: bool, large: bool = False) -> dict:
     }
 
 
+def _bytes_on_device0(tree) -> int:
+    """Bytes of ``tree``'s leaves resident on device 0 — the per-chip
+    memory footprint, read from the arrays' addressable shards (a
+    replicated leaf counts its FULL size; a sharded leaf only its local
+    slice), so the replicated-vs-ZeRO-1 HBM delta is measured, not
+    inferred."""
+    dev0 = jax.devices()[0]
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards is None:
+            total += getattr(leaf, "nbytes", 0)
+            continue
+        total += sum(s.data.nbytes for s in shards if s.device == dev0)
+    return total
+
+
+def bench_zero1(on_tpu: bool, n_devices: int) -> dict:
+    """``--zero1`` mode: the ZeRO-1 comparison protocol (BASELINE.md).
+
+    Four engines train the SAME flagship LM on the SAME ``{"data": N}``
+    mesh with the SAME global batch, so every delta is the weight-update
+    strategy and nothing else:
+
+    - ``dp_replicated``  — allreduce grads, every chip runs the full update
+    - ``dp_zero1``       — reduce-scatter grads, 1/N update, all_gather params
+    - ``dp_zero1_overlap`` — double-buffered variant (gather at step START,
+      accum_steps=2 so compute exists to hide it under)
+    - ``fsdp``           — 1-D param sharding, the other point on the
+      memory/comm trade-off curve
+
+    Per engine: pipelined sec/step (the engines' donated-state protocol —
+    fine for RELATIVE comparison on one box; the fori headline stays the
+    absolute clock) plus per-chip param and optimizer-state bytes from
+    the arrays' addressable shards. The ZeRO-1 rows also carry the
+    exposed-vs-hidden comm attribution from ``overlap_report``.
+    """
+    from tpudml.core.config import MeshConfig
+    from tpudml.core.dist import make_mesh
+    from tpudml.core.prng import seed_key
+    from tpudml.data.datasets import synthetic_lm
+    from tpudml.models import TransformerLM
+    from tpudml.optim import make_optimizer
+    from tpudml.parallel.dp import DataParallel
+    from tpudml.parallel.fsdp import FSDP
+
+    if on_tpu:
+        cfg = dict(vocab_size=32768, embed_dim=512, num_heads=4, num_layers=6)
+        seq_len, per_chip_batch, iters = 1024, 8, 20
+    else:  # CPU dryrun: tiny LM, enough steps to median away jitter
+        cfg = dict(vocab_size=256, embed_dim=64, num_heads=4, num_layers=2)
+        seq_len, per_chip_batch, iters = 128, 4, 6
+    batch = per_chip_batch * n_devices
+    model = TransformerLM(
+        **cfg,
+        max_len=seq_len,
+        impl="flash" if on_tpu else "full",
+        rope=True,
+        compute_dtype=jnp.bfloat16 if on_tpu else None,
+        fused_ln=on_tpu,
+    )
+    opt = make_optimizer("adamw", 3e-4)
+    seqs = jnp.asarray(synthetic_lm(batch, seq_len, cfg["vocab_size"], seed=1))
+    x, y = seqs[:, :-1], seqs[:, 1:]
+
+    mesh = make_mesh(MeshConfig(axes={"data": n_devices}), jax.devices())
+    fused = True  # the flagship head; composes with zero1 and accum
+    engines = {
+        "dp_replicated": lambda: DataParallel(
+            model, opt, mesh, fused_xent=fused),
+        "dp_zero1": lambda: DataParallel(
+            model, opt, mesh, fused_xent=fused, zero1=True),
+        "dp_zero1_overlap": lambda: DataParallel(
+            model, opt, mesh, fused_xent=fused, zero1=True,
+            zero1_overlap=True, accum_steps=2),
+        "fsdp": lambda: FSDP(model, opt, mesh, fused_xent=fused),
+    }
+
+    rows: dict[str, dict] = {}
+    reports: dict[str, dict] = {}
+    for name, build in engines.items():
+        eng = build()
+        ts = eng.create_state(seed_key(0))
+        row = {
+            "params_bytes_per_chip": _bytes_on_device0(ts.params),
+            "opt_state_bytes_per_chip": _bytes_on_device0(ts.opt_state),
+        }
+        step = eng.make_train_step()
+        # Bytes were read above; the timing loop is free to donate ts.
+        row["sec_per_step"] = round(_time_pipelined(step, ts, (x, y), iters), 6)
+        rows[name] = row
+        if name in ("dp_zero1", "dp_zero1_overlap"):
+            # Fresh (undonated) state for the attribution spans.
+            reports[name] = {
+                k: (round(v, 6) if isinstance(v, float) else v)
+                for k, v in eng.overlap_report(
+                    eng.create_state(seed_key(0)), x, y,
+                    iters=10 if on_tpu else 4, warmup=2 if on_tpu else 1,
+                ).items()
+            }
+
+    rep, zro = rows["dp_replicated"], rows["dp_zero1"]
+    return {
+        "metric": "zero1_weight_update_sharding_comparison",
+        "config": {**cfg, "seq_len": seq_len, "global_batch": batch,
+                   "n_devices": n_devices, "fused_xent": fused,
+                   "optimizer": "adamw"},
+        "protocol": "pipelined_relative",
+        "on_tpu": on_tpu,
+        "rows": rows,
+        "opt_state_bytes_ratio_zero1_vs_replicated": round(
+            zro["opt_state_bytes_per_chip"] / rep["opt_state_bytes_per_chip"],
+            4),
+        "sec_per_step_ratio_zero1_vs_replicated": round(
+            zro["sec_per_step"] / rep["sec_per_step"], 4),
+        "overlap": reports,
+    }
+
+
+def main_zero1() -> None:
+    """Driver for ``python bench.py --zero1``: prints ONE JSON line, same
+    contract as ``main()`` but for the ZeRO-1 comparison. Self-provisions
+    an 8-device CPU mesh when no accelerator is visible (same dance as
+    the analysis CLI), since the comparison is meaningless on one chip."""
+    import os
+
+    if "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""
+    ) and not os.environ.get("TPU_NAME"):
+        # Harmless if a real backend is present: the flag only affects the
+        # CPU platform. Must be set before the backend initializes.
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    on_tpu = jax.devices()[0].platform != "cpu"
+    n_devices = jax.device_count()
+    print(json.dumps(bench_zero1(on_tpu, n_devices)))
+
+
 def main() -> None:
     # The TPU chip may surface under a tunnel platform name (e.g. "axon").
     on_tpu = jax.devices()[0].platform != "cpu"
@@ -447,4 +587,8 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+
+    # --zero1 is a separate report (its own single JSON line); the bare
+    # invocation's driver contract is untouched.
+    main_zero1() if "--zero1" in sys.argv[1:] else main()

@@ -18,7 +18,7 @@ single-host multi-device (default), simulated devices
 (jax.distributed).
 
 Run: ``python -m tasks.task2 [--aggregation allgather] [--measure_comm]
-[--bottleneck_rank 1] [--n_devices 2]``
+[--zero1] [--bottleneck_rank 1] [--n_devices 2]``
 """
 
 from __future__ import annotations
@@ -84,6 +84,7 @@ def run(cfg: TrainConfig) -> dict:
         optimizer,
         mesh,
         aggregation=cfg.aggregation,
+        zero1=cfg.zero1,
         measure_comm=cfg.measure_comm or cfg.bottleneck_rank is not None,
         bottleneck_rank=cfg.bottleneck_rank,
         bottleneck_delay_s=cfg.bottleneck_delay_s,

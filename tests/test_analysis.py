@@ -220,6 +220,63 @@ def test_j107_marker_names_match_kernel_module():
     assert jaxpr_pass.SHARDED_XENT_NAME == xent_kernel.SHARDED_XENT_MARKER
 
 
+def test_j108_replicated_update_under_data_axis():
+    """J108 fires on the replicated-DP shape (≥2 gradient psums over a
+    data axis, matching outputs returned replicated, no reduce-scatter)
+    and stays silent for the ZeRO-1 shape (psum_scatter present) and for
+    the FSDP shape (outputs sharded over the axis)."""
+    from jax.sharding import PartitionSpec as P
+
+    from tpudml.core.config import MeshConfig
+    from tpudml.core.dist import make_mesh
+    from tpudml.parallel.sharding import shard_map_fn
+
+    mesh = make_mesh(MeshConfig({"data": 2}), jax.devices()[:2])
+    p1, p2 = jnp.ones((8, 4)), jnp.ones((16,))
+    x = jnp.ones((4, 4))
+
+    def replicated_update(p1, p2, x):
+        s = x.sum()
+        g1 = jax.lax.pmean(p1 * s, "data")
+        g2 = jax.lax.pmean(p2 * s, "data")
+        return p1 - 0.1 * g1, p2 - 0.1 * g2
+
+    bad = shard_map_fn(
+        replicated_update, mesh,
+        in_specs=(P(), P(), P("data")), out_specs=(P(), P()))
+    found = analyze_callable(bad, (p1, p2, x), "fix-j108")
+    assert "J108" in _rules(found)
+    (f,) = [f for f in found if f.rule == "J108"]
+    assert "reduce-scatter" in f.message
+
+    def zero1_update(p1, p2, x):
+        s = x.sum()
+        c1 = jax.lax.psum_scatter(
+            (p1 * s).reshape(-1), "data", scatter_dimension=0, tiled=True)
+        c2 = jax.lax.psum_scatter(
+            p2 * s, "data", scatter_dimension=0, tiled=True)
+        n1 = jax.lax.all_gather(c1 / 2, "data", axis=0, tiled=True)
+        n2 = jax.lax.all_gather(c2 / 2, "data", axis=0, tiled=True)
+        return p1 - 0.1 * n1.reshape(p1.shape), p2 - 0.1 * n2
+
+    ok_z = shard_map_fn(
+        zero1_update, mesh,
+        in_specs=(P(), P(), P("data")), out_specs=(P(), P()))
+    assert "J108" not in _rules(analyze_callable(ok_z, (p1, p2, x), "ok-z1"))
+
+    def sharded_out_update(p1, p2, x):
+        s = x.sum()
+        g1 = jax.lax.pmean(p1 * s, "data")
+        g2 = jax.lax.pmean(p2 * s, "data")
+        return p1 - 0.1 * g1, p2 - 0.1 * g2
+
+    ok_f = shard_map_fn(
+        sharded_out_update, mesh,
+        in_specs=(P(), P(), P("data")), out_specs=(P("data"), P("data")))
+    assert "J108" not in _rules(
+        analyze_callable(ok_f, (p1, p2, x), "ok-fsdp"))
+
+
 def test_j100_trace_failure_becomes_finding():
     def broken(x):
         return x + jnp.ones((x.shape[0] + 1,))  # shape mismatch at trace
@@ -243,7 +300,8 @@ def test_donation_parser_reads_aliasing():
 
 
 @pytest.mark.parametrize(
-    "name", ["task2_dp", "fsdp", "pp_gpipe", "tp_fused", "fsdp_fused"])
+    "name",
+    ["task2_dp", "dp_zero1", "fsdp", "pp_gpipe", "tp_fused", "fsdp_fused"])
 def test_entrypoints_trace_on_cpu(name):
     """The acceptance floor: the DP, FSDP, and pipeline steps trace and
     analyze without TPU hardware, with no error-severity findings and

@@ -161,6 +161,26 @@ def test_dp_fused_xent_matches_unfused():
     _assert_tree_close(ts_f.params, ts_u.params)
 
 
+@pytest.mark.slow
+def test_dp_fused_xent_with_accum_matches_plain():
+    """fused_xent × accum_steps (previously rejected at construction):
+    the fused loss threads through the micro-batch scan with grad-exact
+    parity — mean of equal-chunk token means == batch token mean."""
+    mesh = make_mesh(MeshConfig({"data": 2}), jax.devices()[:2])
+    model = _lm(impl="full")
+    common = dict(stacked_batches=False, fused_xent=True)
+    ts_a, loss_a = _run_steps(
+        DataParallel(
+            model, make_optimizer("sgd", 0.05), mesh, accum_steps=2, **common
+        )
+    )
+    ts_1, loss_1 = _run_steps(
+        DataParallel(model, make_optimizer("sgd", 0.05), mesh, **common)
+    )
+    np.testing.assert_allclose(loss_a, loss_1, rtol=1e-5)
+    _assert_tree_close(ts_a.params, ts_1.params)
+
+
 # ------------------------------------------ sharded head (TP/FSDP) × fused
 
 

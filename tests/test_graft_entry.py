@@ -70,10 +70,12 @@ def test_dryrun_in_process_after_backend_init():
     """The latched-backend path: jax already initialized (conftest's 8-CPU
     mesh counts) must not break provisioning for n <= device_count. The
     regimes filter keeps this to one compile — full-regime coverage is
-    the driver's round-end dryrun + the per-engine parity tests."""
+    the driver's round-end dryrun + the per-engine parity tests. dpzero1
+    runs the same DataParallel engine as the old "dp" pick PLUS the
+    ZeRO-1 sharded update, so one regime covers both paths."""
     import jax
 
     assert jax.device_count() >= 4
     import __graft_entry__
 
-    __graft_entry__.dryrun_multichip(4, regimes=("dp",))
+    __graft_entry__.dryrun_multichip(4, regimes=("dpzero1",))

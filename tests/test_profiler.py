@@ -5,6 +5,8 @@ import os
 
 import jax.numpy as jnp
 import numpy as np
+import pytest
+
 from tpudml.metrics.profiler import SpanTimer, annotate, trace
 
 
@@ -62,6 +64,12 @@ def test_task1_checkpoint_resume_cli(tmp_path):
     assert np.isfinite(metrics["loss"])
 
 
+# Slow lane: jax.profiler's stop_trace has been observed to take 6+ min
+# in this container when finalizing a full-epoch trace (training itself
+# finishes in ~10 s; the hang is entirely inside the trace export) —
+# that is most of the tier-1 time budget for one test. The trace API
+# itself stays pinned fast by test_trace_captures_events above.
+@pytest.mark.slow
 def test_task1_profile_flag_writes_trace(tmp_path):
     from tasks.task1 import main
 

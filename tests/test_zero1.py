@@ -1,0 +1,390 @@
+"""ZeRO-1 weight-update sharding (arXiv 2004.13336) on the DP hot path.
+
+Load-bearing properties:
+
+- **Parity**: reduce-scatter → 1/N-shard update → all_gather is the SAME
+  optimization as allreduce → replicated update — params match the
+  replicated engine at rtol=1e-5/atol=1e-6 over multiple steps, for
+  divisible and non-divisible leaf sizes (LeNet's odd-sized filters),
+  stateful optimizers (Adam / SGD-momentum), gradient accumulation, and
+  a global-norm clip chain.
+- **Memory**: the optimizer moments live sharded 1/N over the data axis —
+  per-chip opt-state bytes shrink accordingly (this is the whole point).
+- **Overlap variant**: param chunks in TrainState + gather-at-step-start
+  trains the same trajectory; ``gather_params`` reassembles originals.
+- **Accounting**: the split ZeRO-1 step charges the weight-update
+  exchange to comm_stats; ``overlap_report`` decomposes exposed vs
+  hidden comm; CommStats gains p50/p99; comm_time_table covers every
+  aggregation strategy.
+- **Composition**: a ZeRO1 optimizer rides the PP×DP pipeline engines
+  (stacked stage leaves chunk along the feature axis, sharded over
+  ``("stage", "data")``).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpudml.comm.timing import CommStats, comm_time_table
+from tpudml.core.config import MeshConfig
+from tpudml.core.dist import make_mesh
+from tpudml.core.prng import seed_key
+from tpudml.data.datasets import synthetic_classification
+from tpudml.models import LeNet
+from tpudml.optim import Adam, ClipByGlobalNorm, ZeRO1, make_optimizer, with_stacked
+from tpudml.parallel.dp import DataParallel
+
+GLOBAL_BATCH = 32
+
+
+def data_mesh(world):
+    return make_mesh(MeshConfig({"data": world}), jax.devices()[:world])
+
+
+@pytest.fixture(scope="module")
+def batch():
+    images, labels = synthetic_classification(GLOBAL_BATCH, (28, 28, 1), 10, seed=7)
+    return np.asarray(images), np.asarray(labels)
+
+
+def params_allclose(a, b, rtol=1e-5, atol=1e-6):
+    flat_a = jax.tree.leaves(a)
+    flat_b = jax.tree.leaves(b)
+    assert len(flat_a) == len(flat_b)
+    for xa, xb in zip(flat_a, flat_b):
+        np.testing.assert_allclose(
+            np.asarray(xa), np.asarray(xb), rtol=rtol, atol=atol
+        )
+
+
+def run_steps(engine, batch, n=3, seed=0):
+    ts = engine.create_state(seed_key(seed))
+    step = engine.make_train_step()
+    losses = []
+    for _ in range(n):
+        ts, m = step(ts, *batch)
+        losses.append(float(m["loss"]))
+    return ts, losses
+
+
+# ------------------------------------------------------------ parity
+
+
+# Tier-1 keeps only the cheapest variant of each parity claim; the rest
+# ride the slow marker (the full suite sat at 863.7 s of the 870 s tier-1
+# budget BEFORE this file existed — every fast-lane second here is real).
+@pytest.mark.parametrize(
+    "world,opt_name",
+    [
+        (2, "adam"),
+        pytest.param(4, "adam", marks=pytest.mark.slow),
+        pytest.param(4, "sgd", marks=pytest.mark.slow),
+    ],
+)
+def test_zero1_matches_replicated_dp(batch, world, opt_name):
+    """LeNet's leaves (150/2400/48000/850-element filters, 6/16/10-element
+    biases) are mostly NOT divisible by the world size, so the padded
+    chunking is exercised on every leaf."""
+    mesh = data_mesh(world)
+    model = LeNet()
+
+    def build(zero1):
+        opt = make_optimizer(opt_name, 1e-2, 0.9)
+        return DataParallel(model, opt, mesh, zero1=zero1)
+
+    ts_z, losses_z = run_steps(build(True), batch)
+    ts_r, losses_r = run_steps(build(False), batch)
+    np.testing.assert_allclose(losses_z, losses_r, rtol=1e-5)
+    params_allclose(ts_z.params, ts_r.params)
+
+
+@pytest.mark.slow
+def test_zero1_with_accum_matches(batch):
+    mesh = data_mesh(4)
+    model = LeNet()
+
+    def build(zero1):
+        return DataParallel(
+            model, make_optimizer("adam", 1e-3), mesh, zero1=zero1,
+            accum_steps=2,
+        )
+
+    ts_z, losses_z = run_steps(build(True), batch)
+    ts_r, losses_r = run_steps(build(False), batch)
+    np.testing.assert_allclose(losses_z, losses_r, rtol=1e-5)
+    params_allclose(ts_z.params, ts_r.params)
+
+
+@pytest.mark.slow
+def test_zero1_with_global_norm_clip_matches(batch):
+    """ZeRO-1 rewraps the clip to compute the global norm from disjoint
+    chunks via psum over the data axis — same norm, same clip factor,
+    same trajectory (max_norm small enough that the clip binds)."""
+    mesh = data_mesh(4)
+    model = LeNet()
+
+    def build(zero1):
+        opt = ClipByGlobalNorm(Adam(lr=1e-3), max_norm=0.05)
+        return DataParallel(model, opt, mesh, zero1=zero1)
+
+    ts_z, losses_z = run_steps(build(True), batch)
+    ts_r, losses_r = run_steps(build(False), batch)
+    np.testing.assert_allclose(losses_z, losses_r, rtol=1e-5)
+    params_allclose(ts_z.params, ts_r.params)
+
+
+@pytest.mark.slow
+def test_zero1_overlap_matches_replicated(batch):
+    """The double-buffered variant (param chunks in TrainState, gather at
+    step START) is the same math; gather_params reassembles originals."""
+    mesh = data_mesh(4)
+    model = LeNet()
+
+    dp_o = DataParallel(
+        model, make_optimizer("adam", 1e-3), mesh,
+        zero1=True, zero1_overlap=True, accum_steps=2,
+    )
+    ts_o = dp_o.create_state(seed_key(0))
+    step_o = dp_o.make_train_step()
+    losses_o = []
+    for _ in range(3):
+        ts_o, m = step_o(ts_o, *batch)
+        losses_o.append(float(m["loss"]))
+
+    dp_r = DataParallel(
+        model, make_optimizer("adam", 1e-3), mesh, accum_steps=2
+    )
+    ts_r, losses_r = run_steps(dp_r, batch)
+
+    np.testing.assert_allclose(losses_o, losses_r, rtol=1e-5)
+    params_allclose(dp_o.gather_params(ts_o), ts_r.params)
+
+
+# ------------------------------------------------------------ memory
+
+
+def _opt_bytes_on_device0(ts):
+    total = 0
+    for leaf in jax.tree.leaves(ts.opt_state):
+        shards = [s for s in leaf.addressable_shards if s.device == jax.devices()[0]]
+        total += sum(np.asarray(s.data).nbytes for s in shards)
+    return total
+
+
+def test_zero1_opt_state_is_sharded_one_over_n(batch):
+    """THE memory claim: per-chip Adam moment bytes ~ 1/N of the
+    replicated engine's (exactly ceil(n/N) per leaf, so slightly above
+    1/N from padding on LeNet's small biases)."""
+    world = 4
+    mesh = data_mesh(world)
+    model = LeNet()
+
+    dp_z = DataParallel(model, make_optimizer("adam", 1e-3), mesh, zero1=True)
+    ts_z = dp_z.create_state(seed_key(0))
+    dp_r = DataParallel(model, make_optimizer("adam", 1e-3), mesh)
+    ts_r = dp_r.create_state(seed_key(0))
+
+    z_bytes = _opt_bytes_on_device0(ts_z)
+    r_bytes = _opt_bytes_on_device0(ts_r)
+    assert z_bytes < r_bytes / world * 1.2, (z_bytes, r_bytes)
+    assert z_bytes > r_bytes / world * 0.8, (z_bytes, r_bytes)
+
+    # The moments really carry the data axis in their sharding spec.
+    biggest = max(jax.tree.leaves(ts_z.opt_state), key=lambda x: x.size)
+    assert "data" in str(biggest.sharding.spec)
+
+    # Parity still holds from this sharded state.
+    step = dp_z.make_train_step()
+    ts_z, m = step(ts_z, *batch)
+    assert np.isfinite(float(m["loss"]))
+
+
+# ------------------------------------------------------- comm accounting
+
+
+@pytest.mark.slow
+def test_zero1_split_step_counts_comm_and_matches_fused(batch):
+    mesh = data_mesh(4)
+    model = LeNet()
+
+    fused = DataParallel(model, make_optimizer("adam", 1e-3), mesh, zero1=True)
+    ts_f, losses_f = run_steps(fused, batch)
+
+    split = DataParallel(
+        model, make_optimizer("adam", 1e-3), mesh, zero1=True,
+        measure_comm=True,
+    )
+    ts_s, losses_s = run_steps(split, batch)
+
+    np.testing.assert_allclose(losses_s, losses_f, rtol=1e-4)
+    params_allclose(ts_s.params, ts_f.params, rtol=1e-4, atol=1e-5)
+    assert split.comm_stats.calls == 3
+    assert split.comm_stats.comm_time_s > 0.0
+    assert "p50" in split.comm_stats.report()
+
+
+@pytest.mark.slow
+def test_overlap_report_decomposes_exposed_vs_hidden(batch):
+    mesh = data_mesh(4)
+    dp = DataParallel(LeNet(), make_optimizer("adam", 1e-3), mesh, zero1=True)
+    ts = dp.create_state(seed_key(0))
+    rep = dp.overlap_report(ts, *batch, iters=2, warmup=1)
+    for key in ("fused_s", "compute_s", "comm_s", "exposed_comm_s",
+                "hidden_comm_s", "overlap_frac"):
+        assert key in rep and rep[key] >= 0.0, rep
+    np.testing.assert_allclose(
+        rep["exposed_comm_s"] + rep["hidden_comm_s"], rep["comm_s"]
+    )
+    assert 0.0 <= rep["overlap_frac"] <= 1.0
+
+
+@pytest.mark.slow
+def test_overlap_report_on_overlap_variant(batch):
+    mesh = data_mesh(2)
+    dp = DataParallel(
+        LeNet(), make_optimizer("adam", 1e-3), mesh,
+        zero1=True, zero1_overlap=True, accum_steps=2,
+    )
+    ts = dp.create_state(seed_key(0))
+    dp.make_train_step()  # the variant's own program must also build
+    rep = dp.overlap_report(ts, *batch, iters=2, warmup=1)
+    assert rep["overlap_step_s"] > 0.0
+
+
+def test_comm_stats_percentiles():
+    cs = CommStats()
+    assert cs.percentiles() == {}
+    assert "p50" not in cs.report()
+    for dt in (0.01, 0.02, 0.03):
+        cs.add(dt)
+    pct = cs.percentiles()
+    np.testing.assert_allclose(pct["p50_s"], 0.02)
+    assert 0.02 < pct["p99_s"] <= 0.03
+    rep = cs.report()
+    assert rep.startswith("Total communication time:")
+    assert "p50" in rep and "p99" in rep
+
+
+def test_comm_time_table_covers_every_strategy():
+    mesh = data_mesh(2)
+    grads = {"w": jnp.ones((64, 8)), "b": jnp.ones((8,))}
+    table = comm_time_table(mesh, grads, iters=2, warmup=1)
+    assert set(table) == {"allreduce", "allgather", "reducescatter"}
+    for row in table.values():
+        assert row["median_s"] > 0.0
+
+
+# -------------------------------------------------------- PP×DP stacking
+
+
+@pytest.mark.slow
+def test_pp_dp_zero1_matches_plain_pp_dp():
+    """A ZeRO1 optimizer on the 2-D {data, stage} pipeline: stacked stage
+    leaves chunk along the flattened feature axis (P("stage", "data")
+    moments) and the reduce-scatter over ``data`` doubles as the grads
+    pmean — same trajectory as the replicated PP×DP update."""
+    from tpudml.nn import Activation, Dense, Sequential
+    from tpudml.parallel.pp import GPipe
+
+    mesh = make_mesh(
+        MeshConfig({"data": 2, "stage": 2}), jax.devices()[:4]
+    )
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(8, 8)).astype(np.float32)
+    y = rng.integers(0, 10, size=(8,)).astype(np.int32)
+
+    def pipe(opt):
+        return GPipe(
+            Sequential((Dense(16, 16), Activation(jax.nn.relu))),
+            n_microbatches=2,
+            mesh=mesh,
+            optimizer=opt,
+            prologue=Dense(8, 16),
+            epilogue=Dense(16, 10),
+            batch_axis="data",
+        )
+
+    def run(opt):
+        eng = pipe(opt)
+        ts = eng.create_state(seed_key(1))
+        step = eng.make_train_step()
+        losses = []
+        for _ in range(3):
+            ts, m = step(ts, x, y)
+            losses.append(float(m["loss"]))
+        return ts, losses
+
+    ts_z, losses_z = run(
+        ZeRO1(make_optimizer("adam", 1e-3), axis_name="data", world=2)
+    )
+    ts_r, losses_r = run(make_optimizer("adam", 1e-3))
+    np.testing.assert_allclose(losses_z, losses_r, rtol=1e-5)
+    params_allclose(ts_z.params, ts_r.params, rtol=1e-4, atol=1e-6)
+
+
+# ------------------------------------------------------------- guards
+
+
+def test_zero1_guards(batch):
+    mesh = data_mesh(2)
+    model = LeNet()
+    opt = make_optimizer("adam", 1e-3)
+
+    with pytest.raises(ValueError, match="world"):
+        ZeRO1(opt, axis_name="data")
+    with pytest.raises(ValueError, match="zero1=True"):
+        DataParallel(model, opt, mesh, zero1_overlap=True)
+    with pytest.raises(ValueError, match="aggregation"):
+        DataParallel(model, opt, mesh, zero1=True, aggregation="allgather")
+    with pytest.raises(ValueError, match="accum_steps"):
+        DataParallel(model, opt, mesh, zero1=True, zero1_overlap=True)
+    with pytest.raises(ValueError, match="overlap_report"):
+        DataParallel(
+            model, opt, mesh, zero1=True, zero1_overlap=True,
+            accum_steps=2, measure_comm=True,
+        )
+    # Pre-wrapped optimizer: zero1=True and axis/world agreement required.
+    z = ZeRO1(opt, axis_name="data", world=2)
+    with pytest.raises(ValueError, match="zero1=True"):
+        DataParallel(model, z, mesh)
+    with pytest.raises(ValueError, match="does not match"):
+        DataParallel(
+            model, ZeRO1(opt, axis_name="data", world=4), mesh, zero1=True
+        )
+    # Stacked (pipeline) layout × global-norm clip is rejected: the
+    # two-bucket clip model cannot express the two-axis chunk sharding.
+    clipped = ZeRO1(
+        ClipByGlobalNorm(Adam(lr=1e-3), max_norm=1.0),
+        axis_name="data", world=2,
+    )
+    with pytest.raises(ValueError, match="stacked"):
+        with_stacked(clipped, lambda path: True)
+    # The overlap variant's chunks are distinct by design.
+    dp_o = DataParallel(
+        model, opt, mesh, zero1=True, zero1_overlap=True, accum_steps=2
+    )
+    ts = dp_o.create_state(seed_key(0))
+    with pytest.raises(ValueError, match="zero1_overlap"):
+        dp_o.broadcast_params(ts)
+
+
+def test_zero1_overlap_requires_create_state_first():
+    mesh = data_mesh(2)
+    dp = DataParallel(
+        LeNet(), make_optimizer("adam", 1e-3), mesh,
+        zero1=True, zero1_overlap=True, accum_steps=2,
+    )
+    with pytest.raises(ValueError, match="create_state"):
+        dp.make_train_step()
+
+
+def test_zero1_init_flattens_to_padded_chunks():
+    """State leaves take the flat [world*ceil(n/world)] layout (the unit
+    behind the 1/N placement)."""
+    opt = ZeRO1(Adam(lr=1e-3), axis_name="data", world=4)
+    params = {"w": jnp.ones((3, 5)), "b": jnp.ones((6,))}
+    state = opt.init(params)
+    assert state["m"]["w"].shape == (16,)  # 15 -> pad to 4*4
+    assert state["m"]["b"].shape == (8,)   # 6 -> pad to 4*2
+    assert state["t"].shape == ()

@@ -17,6 +17,7 @@ entrypoint      engine / step builder                  task analogue
 ==============  =====================================  ================
 task1_single    tpudml.train.make_train_step           task1
 task2_dp        parallel/dp.py DataParallel (fused)    task2, task3
+dp_zero1        DataParallel + ZeRO-1 sharded update   task2 --zero1
 task4_mp        parallel/mp.py GSPMDParallel           task4
 fsdp            parallel/fsdp.py FSDP                  task5 --mode fsdp
 tp_fused        GSPMDParallel + sharded fused head     task5 tp --fused_xent
@@ -113,6 +114,23 @@ def build_task2_dp() -> list[Program]:
     step = dp.make_train_step()
     x, y = _lenet_batch()
     return [Program("task2_dp", step.jitted, (ts, x, y))]
+
+
+def build_dp_zero1() -> list[Program]:
+    """Data parallelism with the ZeRO-1 weight-update shard: the traced
+    step must reduce-scatter the gradients and all-gather the params
+    (J108 stays silent — the psum_scatter is the whole point)."""
+    from tpudml.core.prng import seed_key
+    from tpudml.models import LeNet
+    from tpudml.optim import make_optimizer
+    from tpudml.parallel.dp import DataParallel
+
+    dp = DataParallel(LeNet(), make_optimizer("adam", 1e-3),
+                      _mesh("data", 2), zero1=True)
+    ts = dp.create_state(seed_key(0))
+    step = dp.make_train_step()
+    x, y = _lenet_batch()
+    return [Program("dp_zero1", step.jitted, (ts, x, y))]
 
 
 def build_task4_mp() -> list[Program]:
@@ -248,6 +266,7 @@ def build_lm_bf16() -> list[Program]:
 ENTRYPOINTS: dict[str, Callable[[], list[Program]]] = {
     "task1_single": build_task1_single,
     "task2_dp": build_task2_dp,
+    "dp_zero1": build_dp_zero1,
     "task4_mp": build_task4_mp,
     "fsdp": build_fsdp,
     "tp_fused": build_tp_fused,

@@ -32,6 +32,11 @@ RULES: dict[str, tuple[str, str]] = {
     "J105": (WARN, "large constant (>1 MiB) captured by closure instead of "
                    "passed as an argument"),
     "J106": (WARN, "large training-state buffers are never donated"),
+    "J107": (WARN, "unsharded fused cross-entropy head consumes a "
+                   "vocab-sharded kernel (per-shard softmax is wrong)"),
+    "J108": (INFO, "replicated (unsharded) optimizer update under shard_map "
+                   "on a data axis with no reduce-scatter (every chip pays "
+                   "the full update)"),
     "A201": (WARN, "Python for/if over a traced (jnp/lax) value"),
     "A202": (WARN, "jax.random key consumed more than once without split"),
     "A203": (WARN, "epoch loop iterates a loader without set_epoch"),
@@ -48,6 +53,10 @@ HINTS: dict[str, str] = {
             "explicit accumulation (this rule allowlists cleanly)",
     "J105": "pass the array as a (donated) argument so XLA can alias it",
     "J106": "jit the step with donate_argnums on the TrainState",
+    "J107": "use sharded_linear_cross_entropy(axis_name=...) so per-shard "
+            "(lse, picked) statistics merge before the loss",
+    "J108": "shard the weight update: DataParallel(zero1=True) / "
+            "optim.ZeRO1 reduce-scatters grads and updates a 1/N shard",
     "A201": "use lax.cond/lax.fori_loop/jnp.where, or materialize with "
             "float(...) first if this is host-side code",
     "A202": "key, sub = jax.random.split(key) before the second use",

@@ -25,6 +25,11 @@ multi-host slice:
         are silently wrong; the sharded wrapper
         (``sharded_linear_cross_entropy``) merges per-shard statistics
         and stays silent.
+- J108  a REPLICATED optimizer update under ``shard_map`` on a mesh with
+        a data axis: gradient-shaped tensors are allreduced (psum) over
+        the axis and returned replicated, with no reduce-scatter in
+        sight — every chip pays the full optimizer FLOPs/HBM, the exact
+        waste ZeRO-1 weight-update sharding (``optim.zero1``) removes.
 
 The pass is backend-free: everything works on abstract values on CPU.
 """
@@ -74,6 +79,10 @@ SHARDED_XENT_NAME = "_fused_xent_sharded"
 # Primitives a last-dim sharding survives on the way from a shard_map
 # body invar to the fused head's w operand (J107 taint propagation).
 _LASTDIM_PRESERVING = frozenset({"convert_element_type", "copy"})
+
+# Mesh axis names that conventionally carry data parallelism (J108 only
+# reasons about replicated WEIGHT updates, which live on these axes).
+_DATA_AXIS_NAMES = frozenset({"data", "batch"})
 
 
 def _repo_rel(path: str) -> str:
@@ -270,6 +279,85 @@ def _check_fused_xent(obj, tainted: dict[int, tuple[str, ...]],
                 tainted[id(out)] = axes
 
 
+def _scan_update_collectives(obj, axes: tuple[str, ...], acc: dict) -> None:
+    """Recursively collect, for J108: the output shapes of tensor psums
+    over any of ``axes`` (the allreduced gradients), and whether any
+    reduce-scatter over those axes occurs (the ZeRO-1 signature)."""
+    jaxpr, _ = _inner_jaxpr(obj)
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name in ("psum", "psum_scatter", "reduce_scatter"):
+            eq_axes = _eqn_axes(eqn)
+            if any(a in eq_axes for a in axes):
+                if name == "psum":
+                    for ov in eqn.outvars:
+                        shape = tuple(
+                            getattr(getattr(ov, "aval", None), "shape", ())
+                        )
+                        if shape:
+                            acc["psum_shapes"].append(shape)
+                            if "loc" not in acc:
+                                # The shard_map eqn itself carries the
+                                # re-trace frame; the first gradient psum
+                                # points at the aggregation call site.
+                                acc["loc"] = _src_loc(eqn)
+                else:
+                    acc["rs"] = True
+        for sub, _extra in _sub_jaxprs(eqn):
+            _scan_update_collectives(sub, axes, acc)
+
+
+def _check_replicated_update(eqn, entrypoint: str,
+                             findings: list[Finding]) -> None:
+    """J108 for one shard_map equation: the body allreduces ≥2 tensor
+    gradients over a data axis, returns ≥2 matching-shape outputs
+    REPLICATED over that axis (per out_names), and never reduce-scatters
+    — i.e. a replicated weight update. A ZeRO-1 body (psum_scatter on
+    the grads, state outputs sharded over the axis) stays silent, as
+    does a reduce-scatter aggregation strategy."""
+    mesh = eqn.params.get("mesh")
+    body = eqn.params.get("jaxpr")
+    out_names = eqn.params.get("out_names")
+    if mesh is None or body is None or out_names is None:
+        return
+    axes = tuple(
+        a for a in (str(x) for x in mesh.axis_names) if a in _DATA_AXIS_NAMES
+    )
+    if not axes:
+        return
+    acc: dict = {"psum_shapes": [], "rs": False}
+    _scan_update_collectives(body, axes, acc)
+    if acc["rs"] or len(acc["psum_shapes"]) < 2:
+        return
+    budget: dict[tuple, int] = {}
+    for s in acc["psum_shapes"]:
+        budget[s] = budget.get(s, 0) + 1
+    jaxpr, _ = _inner_jaxpr(body)
+    hits = 0
+    for var, names in zip(jaxpr.outvars, out_names):
+        shape = tuple(getattr(getattr(var, "aval", None), "shape", ()))
+        if not shape or budget.get(shape, 0) <= 0:
+            continue
+        sharded_over = set()
+        for dim_axes in names.values():
+            sharded_over.update(str(a) for a in _axis_strs(tuple(dim_axes)))
+        if any(a in sharded_over for a in axes):
+            continue
+        budget[shape] -= 1
+        hits += 1
+    if hits >= 2:
+        f, ln = acc.get("loc") or _src_loc(eqn)
+        findings.append(Finding(
+            "J108",
+            f"replicated optimizer update under shard_map over data axis "
+            f"{list(axes)}: {hits} allreduced gradient-shaped tensors "
+            f"return replicated with no reduce-scatter — every chip "
+            f"applies the FULL weight update (N× optimizer FLOPs and "
+            f"state HBM); ZeRO-1 (optim.zero1) shards it",
+            file=f, line=ln, entrypoint=entrypoint,
+        ))
+
+
 def _walk(obj, bound: frozenset[str], entrypoint: str,
           findings: list[Finding]) -> None:
     jaxpr, consts = _inner_jaxpr(obj)
@@ -318,6 +406,7 @@ def _walk(obj, bound: frozenset[str], entrypoint: str,
             if seed:
                 _check_fused_xent(eqn.params["jaxpr"], seed, entrypoint,
                                   findings)
+            _check_replicated_update(eqn, entrypoint, findings)
         for sub, extra in _sub_jaxprs(eqn):
             _walk(sub, bound | extra, entrypoint, findings)
 

@@ -18,7 +18,12 @@ from tpudml.comm.collectives import (
     psum_tree,
     reduce_scatter_average_gradients,
 )
-from tpudml.comm.timing import CommStats, comm_time_trial
+from tpudml.comm.timing import (
+    CommStats,
+    attribute_overlap,
+    comm_time_table,
+    comm_time_trial,
+)
 
 __all__ = [
     "allgather_average_gradients",
@@ -32,5 +37,7 @@ __all__ = [
     "psum_tree",
     "reduce_scatter_average_gradients",
     "CommStats",
+    "attribute_overlap",
+    "comm_time_table",
     "comm_time_trial",
 ]

@@ -43,9 +43,31 @@ class CommStats:
         self.calls += 1
         self.per_call_s.append(dt)
 
+    def percentiles(self) -> dict:
+        """p50/p99 of the recorded per-call spans (empty dict when no
+        calls were recorded). p99 interpolates over whatever sample count
+        exists — at few calls it tracks the max, which is the honest
+        reading of a small sample."""
+        if not self.per_call_s:
+            return {}
+        arr = np.asarray(self.per_call_s)
+        return {
+            "p50_s": float(np.percentile(arr, 50)),
+            "p99_s": float(np.percentile(arr, 99)),
+        }
+
     def report(self) -> str:
-        # Reference print parity: "Total communication time:" (model-mp.py:79).
-        return f"Total communication time: {self.comm_time_s:.4f}s over {self.calls} calls"
+        # Reference print parity: "Total communication time:" (model-mp.py:79)
+        # — the prefix is load-bearing for output-comparison; percentiles
+        # append after it.
+        line = f"Total communication time: {self.comm_time_s:.4f}s over {self.calls} calls"
+        pct = self.percentiles()
+        if pct:
+            line += (
+                f" (p50 {pct['p50_s'] * 1e3:.2f}ms,"
+                f" p99 {pct['p99_s'] * 1e3:.2f}ms)"
+            )
+        return line
 
 
 def timed_call(stats: CommStats, fn: Callable, *args) -> Any:
@@ -101,4 +123,52 @@ def comm_time_trial(
         "mean_s": float(times_arr.mean()),
         "total_s": float(times_arr.sum()),
         "iters": iters,
+    }
+
+
+def comm_time_table(
+    mesh,
+    grads_like: Any,
+    strategies: dict | None = None,
+    axis_name: str = "data",
+    iters: int = 20,
+    warmup: int = 3,
+) -> dict:
+    """:func:`comm_time_trial` over every aggregation strategy — the
+    task2 comparison table in one call. Defaults to all registered
+    aggregators (allreduce / allgather / reducescatter), so the table
+    covers the ReduceScatter decomposition ZeRO-1 builds on."""
+    from tpudml.comm.collectives import AGGREGATORS
+
+    strategies = AGGREGATORS if strategies is None else strategies
+    return {
+        name: comm_time_trial(
+            mesh, grads_like, agg, axis_name=axis_name, iters=iters,
+            warmup=warmup,
+        )
+        for name, agg in strategies.items()
+    }
+
+
+def attribute_overlap(fused_s: float, compute_s: float, comm_s: float) -> dict:
+    """Split a step's communication time into EXPOSED (the step waited on
+    it) vs HIDDEN (the schedule absorbed it behind compute), from three
+    wall-time spans measured as separate programs on the same inputs:
+    the fused step, the compute-only span, and the comm-only span.
+
+    ``exposed = clamp(fused − compute, 0, comm)``: whatever the fused
+    program costs beyond pure compute is comm it could not hide, bounded
+    by the comm span itself (program-splitting overhead cannot inflate
+    exposure past what the collectives cost in isolation); ``hidden``
+    is the remainder. ``overlap_frac`` = hidden/comm (0 when comm ≈ 0).
+    """
+    exposed = min(max(fused_s - compute_s, 0.0), comm_s)
+    hidden = comm_s - exposed
+    return {
+        "fused_s": fused_s,
+        "compute_s": compute_s,
+        "comm_s": comm_s,
+        "exposed_comm_s": exposed,
+        "hidden_comm_s": hidden,
+        "overlap_frac": (hidden / comm_s) if comm_s > 0 else 0.0,
     }

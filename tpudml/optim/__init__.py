@@ -17,6 +17,7 @@ from tpudml.optim.schedules import (
     step_decay,
     warmup_cosine,
 )
+from tpudml.optim.zero1 import ZeRO1, stages_stacked, with_stacked, zero1_handles
 
 __all__ = [
     "Optimizer",
@@ -34,4 +35,8 @@ __all__ = [
     "linear_warmup",
     "step_decay",
     "warmup_cosine",
+    "ZeRO1",
+    "zero1_handles",
+    "stages_stacked",
+    "with_stacked",
 ]

@@ -1,0 +1,267 @@
+"""ZeRO-1 weight-update sharding as a pure optimizer-wrapper transform.
+
+The transform from "Automatic Cross-Replica Sharding of Weight Update in
+Data-Parallel Training" (arXiv 2004.13336 — the paper this repo's FSDP
+cites): instead of every data replica applying the FULL optimizer update
+to a replicated state, reduce-scatter the gradients over the data axis,
+update a 1/N shard of the parameters + optimizer state per chip, then
+all_gather the updated parameters. Total communication volume equals the
+ring-allreduce the replicated update already paid (allreduce = reduce-
+scatter + all_gather), but optimizer FLOPs and optimizer-state HBM both
+drop by the axis size N.
+
+Design: :class:`ZeRO1` wraps ANY :class:`Optimizer` — the base optimizer
+never learns about sharding; it simply runs on flattened 1/N chunk leaves.
+Layout is per-leaf flatten-and-chunk: each parameter leaf is raveled,
+zero-padded to a multiple of ``world``, and reduce-scattered along that
+flat dim, so non-divisible shapes need no per-shape special cases. The
+zero padding is exact for every optimizer in the repo: a zero gradient
+keeps zero moments and produces a zero update, and decoupled weight decay
+on a zero parameter is zero.
+
+Because ``psum_scatter(g)/N`` over N replicas of the SAME value returns
+that value, ``update`` is idempotent with respect to a prior ``pmean`` —
+callers that already aggregated (PP×DP keeps its metrics pmean) stay
+exact; callers that skip aggregation (DataParallel ``zero1=True``) get
+the mean for free from the reduce-scatter itself.
+
+Engines with stage-stacked parameter leaves (the pipelines' ``stages``
+subtree, leading dim sharded over ``stage``) set the ``stacked`` key-path
+predicate: those leaves flatten per-stage-row to ``[S, N·c]`` so the
+optimizer-state spec ``P(stage, data)`` composes both shardings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from tpudml.optim.optimizers import ClipByGlobalNorm, Optimizer, shard_aware_clip
+
+PyTree = Any
+
+
+def _chain_has_clip(opt: Optimizer) -> bool:
+    while isinstance(opt, Optimizer):
+        if isinstance(opt, ClipByGlobalNorm):
+            return True
+        opt = getattr(opt, "base", None)
+    return False
+
+
+def _flat_pad(x: jax.Array, world: int) -> jax.Array:
+    """Ravel + zero-pad to a multiple of ``world`` (scalars become [1])."""
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    c = -(-n // world)
+    pad = world * c - n
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    return flat
+
+
+def _rows_pad(x: jax.Array, world: int) -> jax.Array:
+    """Stacked-leaf layout: [S, ...] -> [S, world*c], zero-padded columns."""
+    rows = x.reshape(x.shape[0], -1)
+    n = rows.shape[1]
+    c = -(-n // world)
+    pad = world * c - n
+    if pad:
+        rows = jnp.concatenate(
+            [rows, jnp.zeros((rows.shape[0], pad), rows.dtype)], axis=1
+        )
+    return rows
+
+
+@dataclass(frozen=True)
+class ZeRO1(Optimizer):
+    """Weight-update-sharding wrapper: ``base`` runs on 1/N chunk leaves.
+
+    ``world`` must be the static size of ``axis_name`` on the mesh the
+    engine runs on (``mesh.shape[axis_name]``) — ``init``/``init_spec``
+    run OUTSIDE shard_map where the axis is not bound, so the size cannot
+    be inferred. ``stacked`` (optional key-path predicate) marks leaves
+    whose LEADING dim is a stage-stacked dim sharded over another mesh
+    axis (the pipelines' ``stages`` subtree); those keep that dim and
+    chunk the flattened remainder.
+
+    Must be the OUTERMOST optimizer wrapper: any :class:`ClipByGlobalNorm`
+    in the chain below is rewrapped at construction to psum its norm over
+    the data axis (chunk leaves are disjoint across it, so the psum'd
+    chunk norm IS the global norm of the mean gradient — clip-then-update
+    stays exact vs replicated DP). With ``stacked`` set, a clip in the
+    chain is rejected: stacked chunks shard over two axes with different
+    replication per leaf, which the two-bucket clip model cannot express.
+    """
+
+    base: Optimizer = None  # type: ignore[assignment]
+    axis_name: str = "data"
+    world: int = None  # type: ignore[assignment]
+    stacked: Callable[[tuple], bool] | None = None
+
+    def __post_init__(self):
+        if self.base is None:
+            raise ValueError("ZeRO1 needs a base optimizer")
+        if not isinstance(self.world, int) or self.world < 1:
+            raise ValueError(
+                "ZeRO1 needs the static data-axis size: pass "
+                "world=mesh.shape[axis_name]"
+            )
+        if _chain_has_clip(self.base):
+            if self.stacked is not None:
+                raise ValueError(
+                    "ZeRO1(stacked=...) cannot wrap a ClipByGlobalNorm chain: "
+                    "stage-stacked chunks shard over two mesh axes and the "
+                    "clip's single-psum norm would double-count or miss shards"
+                )
+            object.__setattr__(
+                self,
+                "base",
+                shard_aware_clip(self.base, (self.axis_name,), None),
+            )
+
+    # -- layout helpers ---------------------------------------------------
+
+    def _is_stacked(self, path) -> bool:
+        return self.stacked is not None and self.stacked(path)
+
+    def _chunk_len(self, n: int) -> int:
+        return -(-n // self.world)
+
+    def flatten_params(self, params: PyTree) -> PyTree:
+        """FULL (unsharded) flat-padded layout of every leaf: ``[N·c]``,
+        or ``[S, N·c]`` for stacked leaves. This is the global shape of
+        the optimizer-state moment leaves; engines that carry parameter
+        SHARDS in TrainState (the overlap variant) device_put this tree
+        with the ``init_spec`` shardings."""
+        return jax.tree_util.tree_map_with_path(
+            lambda path, p: (
+                _rows_pad(p, self.world)
+                if self._is_stacked(path)
+                else _flat_pad(p, self.world)
+            ),
+            params,
+        )
+
+    # -- Optimizer contract -----------------------------------------------
+
+    def init(self, params):
+        return self.base.init(self.flatten_params(params))
+
+    def init_spec(self, param_specs):
+        """Map the (possibly prefix) param spec tree to chunk-layout
+        specs: ``P(axis)`` flat leaves, ``P(stage_axes, axis)`` for
+        stacked leaves (dim0 keeps whatever the param spec sharded the
+        stage dim over), then defer to ``base.init_spec`` so moment
+        leaves inherit the chunk specs and scalars stay replicated."""
+
+        def spec_leaf(path, spec):
+            if self._is_stacked(path):
+                lead = spec[0] if len(spec) else None
+                return P(lead, self.axis_name)
+            return P(self.axis_name)
+
+        specs = jax.tree_util.tree_map_with_path(
+            spec_leaf, param_specs, is_leaf=lambda x: isinstance(x, P)
+        )
+        return self.base.init_spec(specs)
+
+    def scatter_grads(self, grads: PyTree) -> PyTree:
+        """Reduce-scatter-MEAN each leaf over the data axis: this chip
+        keeps the ``axis_index``-th chunk of the mean gradient. Exact
+        whether or not the grads were already pmean'd (N identical
+        copies sum to N× the value; /N restores it)."""
+
+        def scatter(path, g):
+            if self._is_stacked(path):
+                rows = _rows_pad(g, self.world)
+                chunk = lax.psum_scatter(
+                    rows, self.axis_name, scatter_dimension=1, tiled=True
+                )
+            else:
+                flat = _flat_pad(g, self.world)
+                chunk = lax.psum_scatter(
+                    flat, self.axis_name, scatter_dimension=0, tiled=True
+                )
+            return chunk / self.world
+
+        return jax.tree_util.tree_map_with_path(scatter, grads)
+
+    def shard_params(self, params: PyTree) -> PyTree:
+        """Slice this chip's chunk out of (replicated) full param leaves."""
+        idx = lax.axis_index(self.axis_name)
+
+        def shard(path, p):
+            if self._is_stacked(path):
+                rows = _rows_pad(p, self.world)
+                c = rows.shape[1] // self.world
+                return lax.dynamic_slice(rows, (0, idx * c), (rows.shape[0], c))
+            flat = _flat_pad(p, self.world)
+            c = flat.shape[0] // self.world
+            return lax.dynamic_slice(flat, (idx * c,), (c,))
+
+        return jax.tree_util.tree_map_with_path(shard, params)
+
+    def gather_params(self, chunks: PyTree, template: PyTree) -> PyTree:
+        """All_gather chunk leaves back to full leaves shaped like
+        ``template`` (arrays or ShapeDtypeStructs with the ORIGINAL param
+        shapes); the zero padding is sliced off before reshaping."""
+
+        def gather(path, ch, p):
+            if self._is_stacked(path):
+                full = lax.all_gather(ch, self.axis_name, axis=1, tiled=True)
+                n = math.prod(p.shape[1:]) if len(p.shape) > 1 else 1
+                return full[:, :n].reshape(p.shape)
+            full = lax.all_gather(ch, self.axis_name, axis=0, tiled=True)
+            n = math.prod(p.shape)
+            return full[:n].reshape(p.shape)
+
+        return jax.tree_util.tree_map_with_path(gather, chunks, template)
+
+    def update_shards(self, grads, state, param_chunks):
+        """The sharded update WITHOUT the trailing all_gather: returns
+        ``(new_param_chunks, new_state)``. The overlap engine carries
+        chunks across steps and gathers at the START of the next step so
+        XLA can overlap the gather with the first microbatch's forward."""
+        gchunks = self.scatter_grads(grads)
+        return self.base.update(gchunks, state, param_chunks)
+
+    def update(self, grads, state, params):
+        """Full ZeRO-1 step (inside shard_map, ``axis_name`` bound,
+        ``grads``/``params`` replicated-or-local full leaves, ``state``
+        local chunk leaves): reduce-scatter -> 1/N base update ->
+        all_gather updated params."""
+        gchunks = self.scatter_grads(grads)
+        pchunks = self.shard_params(params)
+        new_chunks, new_state = self.base.update(gchunks, state, pchunks)
+        return self.gather_params(new_chunks, params), new_state
+
+
+def zero1_handles(optimizer, axis_name: str) -> bool:
+    """True when ``optimizer`` is a ZeRO1 over ``axis_name`` — engines use
+    this to SKIP their pre-update gradient pmean over that axis (the
+    reduce-scatter inside ``update`` performs the mean; a prior pmean is
+    harmlessly exact but doubles the gradient traffic)."""
+    return isinstance(optimizer, ZeRO1) and optimizer.axis_name == axis_name
+
+
+def stages_stacked(path) -> bool:
+    """The pipelines' stacked-leaf predicate: leaves under the top-level
+    ``stages`` key carry a leading stage-sharded dim. GPipe fills this
+    into a ``stacked=None`` ZeRO1 automatically at construction."""
+    return bool(path) and getattr(path[0], "key", None) == "stages"
+
+
+def with_stacked(opt: ZeRO1, pred: Callable[[tuple], bool]) -> ZeRO1:
+    """Return ``opt`` with its ``stacked`` predicate filled (no-op when
+    already set)."""
+    if opt.stacked is not None:
+        return opt
+    return dataclasses.replace(opt, stacked=pred)

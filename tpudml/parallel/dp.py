@@ -25,14 +25,14 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpudml.comm.collectives import broadcast_from, get_aggregator, pmean_tree
 from tpudml.nn.losses import softmax_cross_entropy
 from tpudml.comm.timing import CommStats
 from tpudml.core.dist import process_index
 from tpudml.nn.layers import Module
-from tpudml.optim import Optimizer
+from tpudml.optim import Optimizer, ZeRO1
 from tpudml.parallel.sharding import (
     data_sharding,
     replicate,
@@ -41,6 +41,7 @@ from tpudml.parallel.sharding import (
 )
 from tpudml.train import (
     TrainState,
+    accumulate_fused_grads,
     accumulate_grads,
     make_loss_fn,
     resolve_aux_loss_weight,
@@ -79,23 +80,60 @@ class DataParallel:
         aux_loss_weight: float | None = None,
         fused_xent: bool = False,
         save_scores: bool | None = None,
+        zero1: bool = False,
+        zero1_overlap: bool = False,
     ):
         if save_scores and not fused_xent:
             raise ValueError("save_scores requires fused_xent=True")
         if fused_xent and (
-            measure_comm or accum_steps != 1
-            or loss is not softmax_cross_entropy
+            measure_comm or loss is not softmax_cross_entropy
         ):
             # The fused head IS the loss fn (linear cross-entropy); the
-            # split-step timing path, scan-accumulation, and custom
-            # ``loss`` callables all wrap the LOGITS loss fn — wire them
-            # up when a use case appears rather than silently ignoring
-            # the arguments.
+            # split-step timing path and custom ``loss`` callables wrap
+            # the LOGITS loss fn — wire them up when a use case appears
+            # rather than silently ignoring the arguments. (Gradient
+            # accumulation composes: accumulate_fused_grads runs the
+            # fused loss through the same micro-batch scan.)
             raise ValueError(
                 "fused_xent composes with the fused step and the "
                 "built-in cross-entropy only (measure_comm=False, "
-                "accum_steps=1, default loss)"
+                "default loss)"
             )
+        if zero1_overlap and not zero1:
+            raise ValueError("zero1_overlap requires zero1=True")
+        if zero1 and aggregation != "allreduce":
+            # ZeRO-1 REPLACES gradient aggregation: the reduce-scatter
+            # inside the sharded update is the aggregation. Accepting an
+            # alternative strategy here would silently not use it.
+            raise ValueError(
+                "zero1=True replaces gradient aggregation with its own "
+                "reduce-scatter; leave aggregation='allreduce' (the default)"
+            )
+        if zero1_overlap and accum_steps < 2:
+            raise ValueError(
+                "zero1_overlap needs accum_steps >= 2: the overlap hides "
+                "the param all_gather behind the micro-batch scan"
+            )
+        if zero1_overlap and measure_comm:
+            raise ValueError(
+                "measure_comm is unsupported with zero1_overlap (the "
+                "split bracketing assumes the gather-at-end step layout); "
+                "use overlap_report() for exposed/hidden attribution"
+            )
+        if isinstance(optimizer, ZeRO1):
+            if not zero1:
+                raise ValueError(
+                    "a ZeRO1-wrapped optimizer needs zero1=True (the "
+                    "engine must shard the optimizer state it creates)"
+                )
+            if optimizer.axis_name != axis_name or (
+                optimizer.world != mesh.shape[axis_name]
+            ):
+                raise ValueError(
+                    f"ZeRO1(axis_name={optimizer.axis_name!r}, "
+                    f"world={optimizer.world}) does not match the engine's "
+                    f"{axis_name!r} axis of size {mesh.shape[axis_name]}"
+                )
         self.model = model
         self.optimizer = optimizer
         self.mesh = mesh
@@ -113,6 +151,20 @@ class DataParallel:
         self.accum_steps = accum_steps
         self.comm_stats = CommStats()
         self.world = mesh.shape[axis_name]
+        # ZeRO-1 (arXiv 2004.13336): wrap the optimizer so it reduce-
+        # scatters grads and updates a 1/N param/state shard per chip
+        # (see tpudml.optim.zero1). ``zero1_overlap`` additionally keeps
+        # param CHUNKS in TrainState and gathers them at the START of the
+        # step, so XLA overlaps the all_gather with the first micro-
+        # batches' forward.
+        self.zero1 = zero1
+        self.zero1_overlap = zero1_overlap
+        if zero1 and not isinstance(optimizer, ZeRO1):
+            self.optimizer = ZeRO1(
+                optimizer, axis_name=axis_name, world=self.world
+            )
+        self._param_template = None
+        self._gather_fn = None
         # Dense-MoE runs get the Switch load-balancing pressure by default
         # (None → α=0.01 when the model contains MoE layers).
         # fused_xent: the LM head runs through the fused linear-cross-
@@ -132,8 +184,26 @@ class DataParallel:
 
     # ---------------------------------------------------------------- state
 
+    def _state_spec(self):
+        """TrainState PartitionSpec (prefix) tree for the step's shard_map
+        in/out specs and the state placement. Fully replicated unless
+        zero1: then the optimizer state shards 1/N over the data axis
+        (ZeRO1.init_spec), and the overlap variant's param chunks do too."""
+        if not self.zero1:
+            return P()
+        return TrainState(
+            params=P(self.axis_name) if self.zero1_overlap else P(),
+            model_state=P(),
+            opt_state=self.optimizer.init_spec(P()),
+            step=P(),
+        )
+
     def create_state(self, key: jax.Array) -> TrainState:
-        """Init once on host, place replicated on every mesh device.
+        """Init once on host, place on the mesh: fully replicated in the
+        default engine; under zero1 the optimizer-state moments land
+        sharded 1/N over the data axis (this is the HBM win — each chip
+        holds only its chunk of m/v), and the overlap variant stores the
+        params in the same flat chunk layout.
 
         Covers the reference's ``init_parameters`` broadcast contract
         (codes/task2/dist_utils.py:33-37): every replica starts from
@@ -141,12 +211,57 @@ class DataParallel:
         rank-0 collective (see also :meth:`broadcast_params`).
         """
         ts = TrainState.create(self.model, self.optimizer, key)
-        return replicate(ts, self.mesh)
+        if not self.zero1:
+            return replicate(ts, self.mesh)
+        if self.zero1_overlap:
+            # The step needs the ORIGINAL param shapes to gather back into;
+            # remember them before flattening to the chunk layout.
+            self._param_template = jax.tree.map(
+                lambda p: jax.ShapeDtypeStruct(p.shape, p.dtype), ts.params
+            )
+            ts = TrainState(
+                params=self.optimizer.flatten_params(ts.params),
+                model_state=ts.model_state,
+                opt_state=ts.opt_state,
+                step=ts.step,
+            )
+        shardings = jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s),
+            self._state_spec(),
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        return jax.device_put(ts, shardings)
+
+    def gather_params(self, ts: TrainState):
+        """Original-shape full params from a TrainState — the identity
+        unless ``zero1_overlap`` (whose states carry flat param chunks);
+        eval/checkpoint/parity flows call this instead of ``ts.params``."""
+        if not self.zero1_overlap:
+            return ts.params
+        if self._param_template is None:
+            raise ValueError(
+                "zero1_overlap: create_state must run before gather_params "
+                "(the original param shapes come from it)"
+            )
+        if self._gather_fn is None:
+            fn = shard_map_fn(
+                lambda p: self.optimizer.gather_params(p, self._param_template),
+                self.mesh,
+                in_specs=(P(self.axis_name),),
+                out_specs=P(),
+            )
+            self._gather_fn = jax.jit(fn)
+        return self._gather_fn(ts.params)
 
     def broadcast_params(self, ts: TrainState, root: int = 0) -> TrainState:
         """Explicit rank-``root`` parameter broadcast (reference-mechanism
         parity; needed only when replicas may have diverged, e.g. after a
         per-host restore)."""
+        if self.zero1_overlap:
+            raise ValueError(
+                "broadcast_params is meaningless under zero1_overlap: the "
+                "per-chip param chunks are distinct BY DESIGN, not divergent"
+            )
         fn = shard_map_fn(
             lambda p: broadcast_from(p, self.axis_name, root),
             self.mesh,
@@ -202,6 +317,8 @@ class DataParallel:
 
     def make_train_step(self) -> Callable:
         if self.measure_comm:
+            if self.zero1:
+                return self._make_zero1_split_step()
             return self._make_split_step()
         return self._make_fused_step()
 
@@ -215,16 +332,20 @@ class DataParallel:
                 jax.lax.axis_index(self.axis_name),
             )
         if self.fused_xent:
-            (loss, model_state), grads = jax.value_and_grad(
-                self._fused_loss_fn, has_aux=True
-            )(ts.params, ts.model_state, images, labels, rng)
-            local = {"loss": loss}
+            grads, model_state, local = accumulate_fused_grads(
+                self._fused_loss_fn, ts.params, ts.model_state, images,
+                labels, rng, self.accum_steps,
+            )
         else:
             grads, model_state, local = accumulate_grads(
                 self._loss_fn, ts.params, ts.model_state, images, labels, rng,
                 self.accum_steps,
             )
-        grads = self.aggregator(grads, self.axis_name)
+        if not self.zero1:
+            # Under zero1 the reduce-scatter inside optimizer.update IS
+            # the aggregation (mean chunks land on their owning chips);
+            # a pmean here would double the gradient traffic for nothing.
+            grads = self.aggregator(grads, self.axis_name)
         # Cross-replica-consistent BN stats: average the running stats so
         # every replica holds the same model_state (the reference's DDP
         # leaves them divergent per rank; averaged is strictly better and
@@ -242,12 +363,62 @@ class DataParallel:
         )
         return new_ts, metrics
 
+    def _spmd_body_overlap(self, ts: TrainState, images, labels):
+        """Overlap-variant body: ``ts.params`` carries this chip's flat
+        param CHUNKS, so the step OPENS with the all_gather of the
+        previous step's updated params and closes with the sharded update
+        (no trailing gather). The micro-batch scan that follows consumes
+        the gathered params as constants, and XLA is free to schedule
+        each leaf's gather against the early layers' compute — this is
+        the double-buffering: step k's gather hides behind step k's first
+        micro-batches instead of serializing after step k−1's update."""
+        opt = self.optimizer
+        params = opt.gather_params(ts.params, self._param_template)
+        rng = None
+        if self.rng_root is not None:
+            rng = jax.random.fold_in(
+                jax.random.fold_in(self.rng_root, ts.step),
+                jax.lax.axis_index(self.axis_name),
+            )
+        if self.fused_xent:
+            grads, model_state, local = accumulate_fused_grads(
+                self._fused_loss_fn, params, ts.model_state, images, labels,
+                rng, self.accum_steps,
+            )
+        else:
+            grads, model_state, local = accumulate_grads(
+                self._loss_fn, params, ts.model_state, images, labels, rng,
+                self.accum_steps,
+            )
+        model_state = pmean_tree(model_state, self.axis_name)
+        new_chunks, new_opt = opt.update_shards(grads, ts.opt_state, ts.params)
+        metrics = {
+            k: jax.lax.pmean(v, self.axis_name) for k, v in local.items()
+        }
+        new_ts = TrainState(
+            params=new_chunks,
+            model_state=model_state,
+            opt_state=new_opt,
+            step=ts.step + 1,
+        )
+        return new_ts, metrics
+
     def _make_fused_step(self) -> Callable:
+        body = self._spmd_body
+        if self.zero1_overlap:
+            if self._param_template is None:
+                raise ValueError(
+                    "zero1_overlap: call create_state before "
+                    "make_train_step (the step gathers into the original "
+                    "param shapes recorded there)"
+                )
+            body = self._spmd_body_overlap
+        spec = self._state_spec()
         spmd = shard_map_fn(
-            self._spmd_body,
+            body,
             self.mesh,
-            in_specs=(P(), P(self.axis_name), P(self.axis_name)),
-            out_specs=(P(), P()),
+            in_specs=(spec, P(self.axis_name), P(self.axis_name)),
+            out_specs=(spec, P()),
         )
         # Donate the TrainState: params/opt-state buffers update in place,
         # halving their HBM traffic per step. The input state is CONSUMED
@@ -357,6 +528,191 @@ class DataParallel:
         # wrapper interleaves host timing/sleep between dispatches).
         step.programs = (grad_fn, agg_fn, apply_fn)
         return step
+
+    # ------------------------------------------------------------ zero1 aux
+
+    def _zero1_programs(self):
+        """The two split ZeRO-1 programs: (local grads — no collectives)
+        and (the weight-update exchange — reduce-scatter, 1/N update,
+        all_gather, in ONE program). Unlike the replicated split step
+        there is no separate optimizer-apply program: under ZeRO-1 the
+        update compute is interleaved WITH the collectives, so the
+        exchange program is the span comm accounting must charge."""
+        axis = self.axis_name
+        spec = TrainState(
+            params=P(),
+            model_state=P(),
+            opt_state=self.optimizer.init_spec(P()),
+            step=P(),
+        )
+
+        def local_grads(ts: TrainState, images, labels):
+            rng = None
+            if self.rng_root is not None:
+                rng = jax.random.fold_in(
+                    jax.random.fold_in(self.rng_root, ts.step),
+                    jax.lax.axis_index(axis),
+                )
+            if self.fused_xent:
+                grads, model_state, local = accumulate_fused_grads(
+                    self._fused_loss_fn, ts.params, ts.model_state, images,
+                    labels, rng, self.accum_steps,
+                )
+            else:
+                grads, model_state, local = accumulate_grads(
+                    self._loss_fn, ts.params, ts.model_state, images, labels,
+                    rng, self.accum_steps,
+                )
+            stack = lambda t: jax.tree.map(lambda x: x[None], t)
+            return stack(grads), stack(model_state), stack(local)
+
+        grad_fn = jax.jit(
+            shard_map_fn(
+                local_grads,
+                self.mesh,
+                in_specs=(spec, P(axis), P(axis)),
+                out_specs=(P(axis), P(axis), P(axis)),
+            )
+        )
+
+        def exchange(ts: TrainState, stacked_grads, stacked_state):
+            unstack = lambda t: jax.tree.map(lambda x: x[0], t)
+            grads = unstack(stacked_grads)
+            model_state = pmean_tree(unstack(stacked_state), axis)
+            new_params, new_opt = self.optimizer.update(
+                grads, ts.opt_state, ts.params
+            )
+            return TrainState(
+                params=new_params,
+                model_state=model_state,
+                opt_state=new_opt,
+                step=ts.step + 1,
+            )
+
+        ex_fn = jax.jit(
+            shard_map_fn(
+                exchange,
+                self.mesh,
+                in_specs=(spec, P(axis), P(axis)),
+                out_specs=spec,
+            )
+        )
+        return grad_fn, ex_fn
+
+    def _make_zero1_split_step(self) -> Callable:
+        """measure_comm for the ZeRO-1 step: program A (per-shard grads)
+        → [host: optional straggler sleep] → program B (reduce-scatter +
+        sharded update + all_gather; TIMED) — same host bracketing as the
+        replicated split step, charging the whole weight-update exchange
+        to ``comm_stats``."""
+        grad_fn, ex_fn = self._zero1_programs()
+
+        def step(ts: TrainState, images, labels):
+            images, labels = self.shard_batch(images, labels)
+            stacked_grads, stacked_state, stacked_metrics = grad_fn(
+                ts, images, labels
+            )
+            jax.block_until_ready(stacked_grads)
+            if (
+                self.bottleneck_rank is not None
+                and process_index()
+                == self.bottleneck_rank % max(jax.process_count(), 1)
+            ):
+                time.sleep(self.bottleneck_delay_s)
+            t0 = time.perf_counter()
+            new_ts = ex_fn(ts, stacked_grads, stacked_state)
+            jax.block_until_ready(new_ts.params)
+            self.comm_stats.add(time.perf_counter() - t0)
+            metrics = {
+                "loss": jnp.mean(stacked_metrics["loss"]),
+                "accuracy": jnp.mean(stacked_metrics["accuracy"]),
+            }
+            return new_ts, metrics
+
+        step.programs = (grad_fn, ex_fn)
+        return step
+
+    def overlap_report(
+        self, ts: TrainState, images, labels, iters: int = 10, warmup: int = 2
+    ) -> dict:
+        """Exposed-vs-hidden comm attribution for the ZeRO-1 step
+        (:func:`tpudml.comm.timing.attribute_overlap`). Three programs run
+        on the same inputs: the FUSED step (one XLA program — collectives
+        free to overlap with compute), the compute-only span (local
+        grads), and the weight-update exchange alone (reduce-scatter +
+        1/N update + all_gather). ``exposed = clamp(fused − compute, 0,
+        comm)`` is comm time the step actually waits on; ``hidden =
+        comm − exposed`` is what the schedule absorbed.
+
+        For the overlap variant, ``ts`` may carry param chunks — a
+        replicated twin state is rebuilt via :meth:`gather_params` for
+        the canonical spans, and the variant's own step time rides along
+        as ``overlap_step_s`` (its gain shows up as fused-vs-overlap
+        delta, attributable to the hidden gather).
+        """
+        if not self.zero1:
+            raise ValueError("overlap_report requires zero1=True")
+        from tpudml.comm.timing import attribute_overlap
+
+        axis = self.axis_name
+        images, labels = self.shard_batch(images, labels)
+
+        def timed(fn, *args) -> float:
+            for _ in range(warmup):
+                jax.block_until_ready(fn(*args))
+            runs = []
+            for _ in range(iters):
+                t0 = time.perf_counter()
+                jax.block_until_ready(fn(*args))
+                runs.append(time.perf_counter() - t0)
+            runs.sort()
+            return runs[len(runs) // 2]
+
+        overlap_step_s = None
+        if self.zero1_overlap:
+            spec = self._state_spec()
+            own = jax.jit(
+                shard_map_fn(
+                    self._spmd_body_overlap,
+                    self.mesh,
+                    in_specs=(spec, P(axis), P(axis)),
+                    out_specs=(spec, P()),
+                )
+            )
+            overlap_step_s = timed(own, ts, images, labels)
+            full = TrainState(
+                params=self.gather_params(ts),
+                model_state=ts.model_state,
+                opt_state=ts.opt_state,
+                step=ts.step,
+            )
+        else:
+            full = ts
+
+        rep_spec = TrainState(
+            params=P(),
+            model_state=P(),
+            opt_state=self.optimizer.init_spec(P()),
+            step=P(),
+        )
+        fused_fn = jax.jit(
+            shard_map_fn(
+                self._spmd_body,
+                self.mesh,
+                in_specs=(rep_spec, P(axis), P(axis)),
+                out_specs=(rep_spec, P()),
+            )
+        )
+        grad_fn, ex_fn = self._zero1_programs()
+
+        fused_s = timed(fused_fn, full, images, labels)
+        compute_s = timed(grad_fn, full, images, labels)
+        stacked_grads, stacked_state, _ = grad_fn(full, images, labels)
+        comm_s = timed(ex_fn, full, stacked_grads, stacked_state)
+        report = attribute_overlap(fused_s, compute_s, comm_s)
+        if overlap_step_s is not None:
+            report["overlap_step_s"] = overlap_step_s
+        return report
 
 
 def make_dp_train_step(

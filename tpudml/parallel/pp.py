@@ -40,7 +40,14 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from tpudml.comm.collectives import pmean_tree, ppermute_ring, psum_tree
 from tpudml.nn.layers import Module
 from tpudml.nn.losses import accuracy, softmax_cross_entropy
-from tpudml.optim import Optimizer, shard_aware_clip
+from tpudml.optim import (
+    Optimizer,
+    ZeRO1,
+    shard_aware_clip,
+    stages_stacked,
+    with_stacked,
+    zero1_handles,
+)
 from tpudml.parallel.sharding import DispatchThrottle, shard_map_fn
 from tpudml.train import TrainState
 
@@ -161,6 +168,24 @@ class GPipe:
                 f"batch_axis {batch_axis!r} is not an axis of the mesh "
                 f"{dict(mesh.shape)}"
             )
+        if isinstance(self.optimizer, ZeRO1):
+            # PP×DP with ZeRO-1 weight-update sharding: the optimizer
+            # state chunks over the DATA axis on top of the stage layout.
+            if batch_axis is None:
+                raise ValueError(
+                    "a ZeRO1 optimizer needs a data axis to shard the "
+                    "update over: pass batch_axis (PP×DP composition)"
+                )
+            z = self.optimizer
+            if z.axis_name != batch_axis or z.world != mesh.shape[batch_axis]:
+                raise ValueError(
+                    f"ZeRO1(axis_name={z.axis_name!r}, world={z.world}) "
+                    f"does not match batch_axis {batch_axis!r} of size "
+                    f"{mesh.shape[batch_axis]}"
+                )
+            # Stage leaves carry a leading stage-stacked dim the chunking
+            # must preserve (state specs become P(stage, data)).
+            self.optimizer = with_stacked(self.optimizer, stages_stacked)
         self.prologue = prologue
         self.epilogue = epilogue
         self.loss = loss
@@ -341,8 +366,11 @@ class GPipe:
         if self.batch_axis:
             # DP composition: every data-replica pipelined a different
             # batch shard; averaging grads = grad of the global-batch mean
-            # loss (each replica's loss is already its shard mean).
-            grads = pmean_tree(grads, self.batch_axis)
+            # loss (each replica's loss is already its shard mean). A
+            # ZeRO1 optimizer skips the grads pmean — the reduce-scatter
+            # inside its update performs the data-axis mean.
+            if not zero1_handles(self.optimizer, self.batch_axis):
+                grads = pmean_tree(grads, self.batch_axis)
             metrics = {
                 k: lax.pmean(v, self.batch_axis) for k, v in metrics.items()
             }
@@ -626,8 +654,9 @@ class OneFOneB(GPipe):
         }
         if self.batch_axis:
             # PP×DP: average the per-data-replica pipeline grads/metrics
-            # (see GPipe._spmd_step).
-            grads = pmean_tree(grads, self.batch_axis)
+            # (see GPipe._spmd_step; ZeRO1 owns the grad mean itself).
+            if not zero1_handles(self.optimizer, self.batch_axis):
+                grads = pmean_tree(grads, self.batch_axis)
             metrics = {
                 k: lax.pmean(v, self.batch_axis) for k, v in metrics.items()
             }
@@ -1290,7 +1319,9 @@ class Interleaved1F1B(GPipe):
             "accuracy": lax.psum(acc_sum, axis) / M,
         }
         if self.batch_axis:
-            grads = pmean_tree(grads, self.batch_axis)
+            # PP×DP (ZeRO1 owns the grad mean itself; see GPipe._spmd_step).
+            if not zero1_handles(self.optimizer, self.batch_axis):
+                grads = pmean_tree(grads, self.batch_axis)
             metrics = {
                 k: lax.pmean(v, self.batch_axis) for k, v in metrics.items()
             }

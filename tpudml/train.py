@@ -162,6 +162,55 @@ def accumulate_grads(
     return grads, model_state, {"loss": loss_sum * inv, "accuracy": acc_sum * inv}
 
 
+def accumulate_fused_grads(
+    loss_fn: Callable,
+    params: Any,
+    model_state: Any,
+    tokens: jax.Array,
+    labels: jax.Array,
+    rng: jax.Array | None,
+    accum_steps: int,
+):
+    """:func:`accumulate_grads` for FUSED loss fns — those returning
+    ``(loss, new_model_state)`` with no logits aux (the linear-cross-
+    entropy head never materializes them), so metrics carry loss only.
+    Same micro-batch scan, same per-chunk rng fold, same mean semantics:
+    the full-batch gradient at micro-batch activation memory."""
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+    if accum_steps == 1:
+        (loss, model_state), grads = grad_fn(params, model_state, tokens, labels, rng)
+        return grads, model_state, {"loss": loss}
+
+    batch = tokens.shape[0]
+    if batch % accum_steps:
+        raise ValueError(
+            f"(per-replica) batch {batch} not divisible by accum_steps "
+            f"{accum_steps}"
+        )
+    micro = batch // accum_steps
+    mb_tokens = tokens.reshape(accum_steps, micro, *tokens.shape[1:])
+    mb_labels = labels.reshape(accum_steps, micro, *labels.shape[1:])
+
+    zero_grads = jax.tree.map(jnp.zeros_like, params)
+
+    def body(carry, mb):
+        grads_acc, state, loss_acc = carry
+        toks, lbls, i = mb
+        mb_rng = None if rng is None else jax.random.fold_in(rng, i)
+        (loss, state), grads = grad_fn(params, state, toks, lbls, mb_rng)
+        grads_acc = jax.tree.map(jnp.add, grads_acc, grads)
+        return (grads_acc, state, loss_acc + loss), None
+
+    (grads_sum, model_state, loss_sum), _ = jax.lax.scan(
+        body,
+        (zero_grads, model_state, jnp.zeros(())),
+        (mb_tokens, mb_labels, jnp.arange(accum_steps)),
+    )
+    inv = 1.0 / accum_steps
+    grads = jax.tree.map(lambda g: g * inv, grads_sum)
+    return grads, model_state, {"loss": loss_sum * inv}
+
+
 def make_train_step_body(
     model: Module,
     optimizer: Optimizer,
