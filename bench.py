@@ -515,6 +515,85 @@ def bench_zero1(on_tpu: bool, n_devices: int) -> dict:
     }
 
 
+def bench_moe(on_tpu) -> dict:
+    """``--moe`` report: one LM step time for the three MoE FFN paths —
+    gather+capacity, dropless ragged with lax.ragged_dot's stock dW
+    transpose, and dropless ragged with the grouped-dW backward
+    (ops/moe_kernel.py) — at E ∈ {4, 8}, top-1, on the same trunk/
+    protocol as the transformer row (fori differencing, median of 3).
+    Single-shard by construction: dispatch='ragged' rejects EP."""
+    from tpudml.core.prng import seed_key
+    from tpudml.data.datasets import synthetic_lm
+    from tpudml.models import TransformerLM
+    from tpudml.optim import make_optimizer
+    from tpudml.train import TrainState
+
+    if on_tpu:
+        cfg = dict(vocab_size=32768, embed_dim=512, num_heads=4, num_layers=6)
+        seq_len, batch, k_lo, k_hi = 1024, 8, 4, 12
+    else:  # CPU dryrun: wiring + ratio sanity, not chip numbers
+        cfg = dict(vocab_size=256, embed_dim=64, num_heads=4, num_layers=2)
+        seq_len, batch, k_lo, k_hi = 128, 4, 2, 6
+    seqs = jnp.asarray(synthetic_lm(batch, seq_len, cfg["vocab_size"], seed=3))
+    x, y = seqs[:, :-1], seqs[:, 1:]
+
+    variants = {
+        "gather": dict(moe_dispatch="gather"),
+        "ragged_stock": dict(moe_dispatch="ragged", moe_ragged_dw="stock"),
+        "ragged_grouped": dict(moe_dispatch="ragged", moe_ragged_dw="grouped"),
+    }
+    rows: dict[str, dict] = {}
+    for e in (4, 8):
+        for name, kv in variants.items():
+            model = TransformerLM(
+                **cfg,
+                max_len=seq_len,
+                impl="flash" if on_tpu else "full",
+                rope=True,
+                compute_dtype=jnp.bfloat16 if on_tpu else None,
+                fused_ln=on_tpu,
+                moe_experts=e,
+                moe_capacity_factor=1.25,
+                moe_top_k=1,
+                **kv,
+            )
+            opt = make_optimizer("adamw", 3e-4)
+            ts = TrainState.create(model, opt, seed_key(0))
+            body = _make_step_body(model, opt)
+            sec, runs = _time_fori(body, ts, (x, y), k_lo, k_hi)
+            rows[f"E{e}_{name}"] = {
+                "sec_per_step": round(sec, 6),
+                "runs": [round(r, 6) for r in runs],
+            }
+    ratios = {
+        f"E{e}_{name}_vs_gather": round(
+            rows[f"E{e}_{name}"]["sec_per_step"]
+            / rows[f"E{e}_gather"]["sec_per_step"], 4)
+        for e in (4, 8)
+        for name in ("ragged_stock", "ragged_grouped")
+    }
+    return {
+        "metric": "moe_dispatch_backward_comparison",
+        "config": {**cfg, "seq_len": seq_len, "batch": batch,
+                   "capacity_factor": 1.25, "top_k": 1,
+                   "optimizer": "adamw"},
+        "protocol": "fori_median",
+        "on_tpu": on_tpu,
+        # Off-TPU the grouped path runs its reference segment-einsum, not
+        # the Pallas kernel — a CPU row checks wiring, not the kernel.
+        "grouped_dw_backend": "pallas" if on_tpu else "reference_einsum",
+        "rows": rows,
+        "ratios": ratios,
+    }
+
+
+def main_moe() -> None:
+    """Driver for ``python bench.py --moe``: prints ONE JSON line, same
+    contract as ``main()``, for the MoE dispatch/backward comparison."""
+    on_tpu = jax.devices()[0].platform != "cpu"
+    print(json.dumps(bench_moe(on_tpu)))
+
+
 def main_zero1() -> None:
     """Driver for ``python bench.py --zero1``: prints ONE JSON line, same
     contract as ``main()`` but for the ZeRO-1 comparison. Self-provisions
@@ -589,6 +668,11 @@ def main() -> None:
 if __name__ == "__main__":
     import sys
 
-    # --zero1 is a separate report (its own single JSON line); the bare
-    # invocation's driver contract is untouched.
-    main_zero1() if "--zero1" in sys.argv[1:] else main()
+    # --zero1 / --moe are separate reports (each its own single JSON
+    # line); the bare invocation's driver contract is untouched.
+    if "--zero1" in sys.argv[1:]:
+        main_zero1()
+    elif "--moe" in sys.argv[1:]:
+        main_moe()
+    else:
+        main()

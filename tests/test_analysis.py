@@ -277,6 +277,34 @@ def test_j108_replicated_update_under_data_axis():
         analyze_callable(ok_f, (p1, p2, x), "ok-fsdp"))
 
 
+@pytest.mark.parametrize("ragged_dw", ["stock", "grouped"])
+def test_j109_ragged_transpose_backward(ragged_dw):
+    """J109 fires on lax.ragged_dot's stock grouped-transpose dW (both
+    dW sites of the two-matmul FFN — the E-scaled masked batched
+    dot_general) and stays silent when the grouped-dW custom_vjp
+    (ops.moe_kernel.ragged_ffn, the default) owns the backward."""
+    from tpudml.core.prng import seed_key
+    from tpudml.nn.moe import MoELayer
+
+    moe = MoELayer(16, 4, mlp_ratio=2, dispatch="ragged",
+                   ragged_dw=ragged_dw)
+    params, _ = moe.init(seed_key(0))
+    x = jnp.ones((32, 16))
+
+    def loss(p, x):
+        y, st = moe.apply(p, {}, x)
+        return jnp.sum(y**2) + st["aux_loss"]
+
+    findings = analyze_callable(
+        jax.jit(jax.grad(loss)), (params, x), f"j109-{ragged_dw}")
+    fired = [f for f in findings if f.rule == "J109"]
+    if ragged_dw == "stock":
+        assert len(fired) == 2, findings  # dW1 and dW2
+        assert all("4×" in f.message and f.line > 0 for f in fired)
+    else:
+        assert fired == [], fired
+
+
 def test_j100_trace_failure_becomes_finding():
     def broken(x):
         return x + jnp.ones((x.shape[0] + 1,))  # shape mismatch at trace
@@ -301,7 +329,8 @@ def test_donation_parser_reads_aliasing():
 
 @pytest.mark.parametrize(
     "name",
-    ["task2_dp", "dp_zero1", "fsdp", "pp_gpipe", "tp_fused", "fsdp_fused"])
+    ["task2_dp", "dp_zero1", "fsdp", "pp_gpipe", "tp_fused", "fsdp_fused",
+     "moe_ragged"])
 def test_entrypoints_trace_on_cpu(name):
     """The acceptance floor: the DP, FSDP, and pipeline steps trace and
     analyze without TPU hardware, with no error-severity findings and

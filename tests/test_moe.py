@@ -441,13 +441,17 @@ def test_gather_dispatch_validation():
         MoELayer(D, E, dispatch="loop")
 
 
+@pytest.mark.parametrize("ragged_dw", ["grouped", "stock"])
 @pytest.mark.parametrize("top_k", [1, 2])
-def test_ragged_matches_direct_mixture(tokens, top_k):
+def test_ragged_matches_direct_mixture(tokens, top_k, ragged_dw):
     """dispatch='ragged' is DROPLESS: every token reaches all its chosen
     experts regardless of load imbalance, so the direct per-token mixture
     is an exact oracle (no ample-capacity caveat) — outputs, aux loss,
-    and all gradients."""
-    moe = MoELayer(D, E, mlp_ratio=2, top_k=top_k, dispatch="ragged")
+    and all gradients. Runs through both backwards: the grouped-dW
+    custom_vjp (default) and lax.ragged_dot's stock transpose."""
+    moe = MoELayer(
+        D, E, mlp_ratio=2, top_k=top_k, dispatch="ragged", ragged_dw=ragged_dw
+    )
     params, _ = moe.init(seed_key(4))
 
     probs = jax.nn.softmax(tokens @ params["router"]["kernel"], -1)

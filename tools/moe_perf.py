@@ -13,6 +13,16 @@ Prints, for the flagship trunk config (d=512, L=6, T=1024, B=8, bf16):
   router's aux state) and the implied dispatch overhead ms/step.
 
 Protocol: the bench fori clock (K steps per dispatch, differenced).
+
+``--attrib`` runs the per-E fwd/bwd KERNEL attribution instead: the
+isolated expert-FFN composition on presorted rows — stock
+``lax.ragged_dot`` (whose dW transpose is the E-scaled masked matmul)
+vs the grouped-dW ``custom_vjp`` (``ops/moe_kernel.py``) vs the dense
+two-matmul floor at matched active FLOPs — at E ∈ {4, 8}, top-1 and
+top-2. This is the probe behind BASELINE.md's "3.4× backward at E=8"
+number and the one that shows where the grouped kernel buys it back.
+Off-TPU the grouped backward runs its reference segment-einsum, so CPU
+``--attrib`` checks wiring and ratios-of-convenience only.
 """
 
 from __future__ import annotations
@@ -101,6 +111,117 @@ def main():
                 )
 
 
+def _attrib_row(label, fwd_sec, tot_sec):
+    print(
+        f"{label:40s} fwd {fwd_sec*1e3:8.3f} ms   "
+        f"bwd {(tot_sec - fwd_sec)*1e3:8.3f} ms   "
+        f"fwd+bwd {tot_sec*1e3:8.3f} ms",
+        flush=True,
+    )
+
+
+def attrib():
+    """Per-E fwd/bwd kernel attribution of the ragged FFN composition.
+
+    Rows are presorted by expert (the layout ``dispatch='ragged'``
+    guarantees); group sizes come from an untrained router on random
+    tokens — the realistic early-training imbalance. Three paths:
+
+    - ``dense``: plain two-matmul MLP on the same P rows — the
+      E-independent floor at matched active FLOPs;
+    - ``stock``: ``lax.ragged_dot`` composition differentiated as-is
+      (its dW transpose is the E-scaled masked matmul — J109);
+    - ``grouped``: ``ops.moe_kernel.ragged_ffn`` (grouped-dW backward).
+
+    Timing: the bench fori clock. The fwd carry chains the output back
+    into the input (shape-preserving, renormalized) and the bwd carry
+    applies a tiny SGD update, so no iteration is loop-invariant and
+    XLA cannot hoist the work out of the differenced loop.
+    """
+    from jax import lax
+
+    from tpudml.ops.moe_kernel import ragged_ffn
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    d, h, g = (512, 2048, 16384) if on_tpu else (64, 128, 512)
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    k_lo, k_hi, reps = (8, 24, 3) if on_tpu else (1, 3, 1)
+    print(
+        f"kernel attribution: d={d} ffn={h} tokens={g} dtype={jnp.dtype(dtype).name} "
+        f"grouped_dw={'pallas' if on_tpu else 'reference_einsum'}",
+        flush=True,
+    )
+
+    def time_fwd(f, x0):
+        def body(x_carry, xx, yy):
+            y = f(x_carry)
+            # Renormalize so 24 chained applications stay bounded; the
+            # dependency defeats loop-invariant code motion.
+            y = y / (1e-3 + jnp.max(jnp.abs(y.astype(jnp.float32))))
+            return y.astype(x_carry.dtype), jnp.sum(y).astype(jnp.float32)
+
+        sec, _ = _time_fori(body, x0, (x0, x0), k_lo, k_hi, reps=reps)
+        return sec
+
+    def time_tot(f, weights, x0):
+        def body(w_carry, xx, yy):
+            def loss_fn(w):
+                out = f(w, xx)
+                return 0.5 * jnp.sum(out.astype(jnp.float32) ** 2)
+
+            loss, grads = jax.value_and_grad(loss_fn)(w_carry)
+            new_w = jax.tree.map(lambda p, gr: p - 1e-6 * gr, w_carry, grads)
+            return new_w, loss
+
+        sec, _ = _time_fori(body, weights, (x0, x0), k_lo, k_hi, reps=reps)
+        return sec
+
+    for e in (4, 8):
+        for top_k in (1, 2):
+            p = g * top_k
+            kx, kr, k1, kb1, k2, kb2 = jax.random.split(seed_key(11), 6)
+            xt = jax.random.normal(kx, (g, d), jnp.float32)
+            router = jax.random.normal(kr, (d, e), jnp.float32) * d**-0.5
+            _, topi = jax.lax.top_k(jax.nn.softmax(xt @ router), top_k)
+            eids = topi.reshape(p)
+            order = jnp.argsort(eids)
+            group_sizes = jnp.bincount(eids, length=e).astype(jnp.int32)
+            x_sorted = jnp.take(xt, order // top_k, axis=0).astype(dtype)
+            onehot = jax.nn.one_hot(eids[order], e, dtype=dtype)
+            w1 = (jax.random.normal(k1, (e, d, h)) * 0.02).astype(dtype)
+            b1 = (jax.random.normal(kb1, (e, h)) * 0.02).astype(dtype)
+            w2 = (jax.random.normal(k2, (e, h, d)) * 0.02).astype(dtype)
+            b2 = (jax.random.normal(kb2, (e, d)) * 0.02).astype(dtype)
+            sizes = [int(s) for s in group_sizes]
+            print(f"E={e} top-{top_k} P={p} group_sizes={sizes}", flush=True)
+
+            def dense(w, x):
+                hid = jax.nn.relu(x @ w[0] + w[1])
+                return hid @ w[2] + w[3]
+
+            def stock(w, x):
+                hid = jax.nn.relu(
+                    lax.ragged_dot(x, w[0], group_sizes) + onehot @ w[1])
+                return lax.ragged_dot(hid, w[2], group_sizes) + onehot @ w[3]
+
+            def grouped(w, x):
+                return ragged_ffn(x, w[0], w[1], w[2], w[3], onehot,
+                                  group_sizes)
+
+            wd = (w1[0], b1[0], w2[0], b2[0])
+            we = (w1, b1, w2, b2)
+            for label, f, w in (
+                (f"  dense floor [{p}x{d}]x[{d}x{h}]", dense, wd),
+                (f"  ragged stock dW E={e}", stock, we),
+                (f"  ragged grouped dW E={e}", grouped, we),
+            ):
+                _attrib_row(
+                    label,
+                    time_fwd(lambda x, f=f, w=w: f(w, x), x_sorted),
+                    time_tot(f, w, x_sorted),
+                )
+
+
 def capacity_probe(d, experts, cap_factor, n_tokens):
     """(token keep-rate, expert-slot utilization) of a top-1 layer with an
     UNTRAINED router on random tokens — the early-training capacity
@@ -119,4 +240,4 @@ def capacity_probe(d, experts, cap_factor, n_tokens):
 
 
 if __name__ == "__main__":
-    main()
+    attrib() if "--attrib" in sys.argv[1:] else main()

@@ -1,0 +1,135 @@
+"""Capacity-drop quality study: gather-vs-ragged under induced routing
+imbalance (VERDICT item 5).
+
+The question the dispatch matrix leaves open: does the gather path's
+capacity truncation COST QUALITY when routing is imbalanced, and does
+the dropless ragged path buy it back? This tool measures it on a task
+where imbalance is a controlled knob rather than an accident of
+training dynamics:
+
+- inputs are drawn from E Gaussian clusters with distinct means and the
+  regression target is a DIFFERENT linear map per cluster, so a top-1
+  MoE must specialize one expert per cluster to fit;
+- the cluster mixture is the imbalance knob: ``balanced`` = uniform
+  proportions (every expert near 1/E load), ``skewed`` = one cluster
+  carries 70% of the tokens, so its expert's row count blows through a
+  1.25 capacity at E=4 (cap slots ≈ 31% of tokens) and the gather path
+  must drop most of that cluster every step;
+- each (mixture × dispatch) variant trains the same MoELayer from the
+  same init with adam + MSE + the standard aux pressure, recording the
+  loss curve and the exact post-training keep-rate (recomputed from the
+  trained router's top-1 counts against the static capacity — no
+  instrumentation inside the layer).
+
+Output: one loss-curve line per variant plus a final summary table —
+the recording behind BASELINE.md's "gather default, ragged for skew"
+verdict (or its refutation).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpudml.core.prng import seed_key  # noqa: E402
+from tpudml.nn.moe import MoELayer  # noqa: E402
+from tpudml.optim import make_optimizer  # noqa: E402
+
+D = 32
+E = 4
+N_TOKENS = 1024
+STEPS = 400
+RECORD_EVERY = 50
+AUX_WEIGHT = 0.01
+
+MIXTURES = {
+    "balanced": jnp.full((E,), 1.0 / E),
+    "skewed": jnp.array([0.70, 0.15, 0.10, 0.05]),
+}
+
+VARIANTS = (
+    ("gather_cap1.25", dict(dispatch="gather", capacity_factor=1.25)),
+    ("gather_cap2.0", dict(dispatch="gather", capacity_factor=2.0)),
+    ("ragged", dict(dispatch="ragged")),
+)
+
+
+def make_task(key, mixture):
+    """Clustered regression: cluster c's tokens map through its own
+    random linear map — solvable exactly only if every cluster's tokens
+    reach a specialized expert."""
+    kc, km, kx, kn = jax.random.split(key, 4)
+    centers = jax.random.normal(kc, (E, D)) * 3.0
+    maps = jax.random.normal(km, (E, D, D)) / jnp.sqrt(D)
+    cluster = jax.random.choice(kx, E, (N_TOKENS,), p=mixture)
+    x = centers[cluster] + jax.random.normal(kn, (N_TOKENS, D))
+    y = jnp.einsum("nd,ndk->nk", x, maps[cluster])
+    return x, y, cluster
+
+
+def keep_rate(layer, params, x):
+    """Fraction of tokens the trained router keeps under the static
+    capacity: Σ_e min(count_e, cap) / N (ragged keeps everything by
+    construction)."""
+    if layer.dispatch == "ragged":
+        return 1.0
+    logits = x @ params["router"]["kernel"]
+    top1 = jnp.argmax(logits, axis=-1)
+    counts = jnp.bincount(top1, length=E)
+    cap = layer._capacity(x.shape[0])
+    return float(jnp.sum(jnp.minimum(counts, cap)) / x.shape[0])
+
+
+def train_variant(layer, x, y):
+    params, state = layer.init(seed_key(1))
+    opt = make_optimizer("adam", 1e-3)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, model_state):
+        def loss_fn(p):
+            out, new_state = layer.apply(p, model_state, x)
+            mse = jnp.mean((out - y) ** 2)
+            return mse + AUX_WEIGHT * new_state["aux_loss"], (mse, new_state)
+
+        (_, (mse, new_state)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        new_params, new_opt = opt.update(grads, opt_state, params)
+        return new_params, new_opt, new_state, mse
+
+    curve = []
+    for i in range(STEPS):
+        params, opt_state, state, mse = step(params, opt_state, state)
+        if (i + 1) % RECORD_EVERY == 0:
+            curve.append(round(float(mse), 4))
+    return params, curve
+
+
+def main():
+    summary = []
+    for mix_name, mixture in MIXTURES.items():
+        x, y, cluster = make_task(seed_key(0), mixture)
+        frac = [round(float(jnp.mean(cluster == e)), 3) for e in range(E)]
+        print(f"mixture={mix_name} cluster fractions={frac}", flush=True)
+        for var_name, kw in VARIANTS:
+            layer = MoELayer(D, E, mlp_ratio=2, top_k=1, **kw)
+            params, curve = train_variant(layer, x, y)
+            kr = keep_rate(layer, params, x)
+            print(
+                f"  {var_name:16s} keep-rate {kr:6.1%}  "
+                f"loss curve (every {RECORD_EVERY}): {curve}",
+                flush=True,
+            )
+            summary.append((mix_name, var_name, kr, curve[-1]))
+    print("\nfinal-loss summary (mixture, variant, keep-rate, mse@400):")
+    for row in summary:
+        print(f"  {row[0]:9s} {row[1]:16s} {row[2]:6.1%} {row[3]:.4f}")
+
+
+if __name__ == "__main__":
+    main()

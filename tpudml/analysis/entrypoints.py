@@ -262,6 +262,23 @@ def build_lm_bf16() -> list[Program]:
     return [Program("lm_bf16", step, (ts, x, y))]
 
 
+def build_moe_ragged() -> list[Program]:
+    """Single-shard dropless ragged MoE — the surface J109 guards. The
+    default grouped-dW backward must trace J109-silent; flipping
+    moe_ragged_dw='stock' here is the rule's firing fixture (covered in
+    tests/test_analysis.py, not registered as an entrypoint)."""
+    from tpudml.core.prng import seed_key
+    from tpudml.optim import make_optimizer
+    from tpudml.train import TrainState, make_train_step
+
+    lm = _tiny_lm(moe_experts=2, moe_dispatch="ragged")
+    opt = make_optimizer("adam", 0.01)
+    ts = TrainState.create(lm, opt, seed_key(0))
+    step = make_train_step(lm, opt)
+    x, y = _lm_batch()
+    return [Program("moe_ragged", step, (ts, x, y))]
+
+
 #: name -> builder; order is reporting order.
 ENTRYPOINTS: dict[str, Callable[[], list[Program]]] = {
     "task1_single": build_task1_single,
@@ -274,6 +291,7 @@ ENTRYPOINTS: dict[str, Callable[[], list[Program]]] = {
     "pp_gpipe": build_pp_gpipe,
     "cp_ring": build_cp_ring,
     "ep_moe": build_ep_moe,
+    "moe_ragged": build_moe_ragged,
     "lm_bf16": build_lm_bf16,
 }
 

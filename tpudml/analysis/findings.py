@@ -37,6 +37,9 @@ RULES: dict[str, tuple[str, str]] = {
     "J108": (INFO, "replicated (unsharded) optimizer update under shard_map "
                    "on a data axis with no reduce-scatter (every chip pays "
                    "the full update)"),
+    "J109": (WARN, "ragged_dot's E-scaled grouped-transpose dW in the "
+                   "backward (E× the dense dW FLOPs via masked [E, P, ·] "
+                   "broadcasts)"),
     "A201": (WARN, "Python for/if over a traced (jnp/lax) value"),
     "A202": (WARN, "jax.random key consumed more than once without split"),
     "A203": (WARN, "epoch loop iterates a loader without set_epoch"),
@@ -57,6 +60,9 @@ HINTS: dict[str, str] = {
             "(lse, picked) statistics merge before the loss",
     "J108": "shard the weight update: DataParallel(zero1=True) / "
             "optim.ZeRO1 reduce-scatters grads and updates a 1/N shard",
+    "J109": "route the ragged FFN through ops.moe_kernel.ragged_ffn "
+            "(MoELayer ragged_dw='grouped'): grouped-dW accumulates each "
+            "expert's contiguous slab at cost ∝ tokens",
     "A201": "use lax.cond/lax.fori_loop/jnp.where, or materialize with "
             "float(...) first if this is host-side code",
     "A202": "key, sub = jax.random.split(key) before the second use",

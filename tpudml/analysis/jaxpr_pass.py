@@ -30,6 +30,12 @@ multi-host slice:
         the axis and returned replicated, with no reduce-scatter in
         sight — every chip pays the full optimizer FLOPs/HBM, the exact
         waste ZeRO-1 weight-update sharding (``optim.zero1``) removes.
+- J109  ``lax.ragged_dot``'s stock grouped-transpose dW surviving into a
+        backward: both dW operands materialized as ``[E, P, ·]``
+        range-masked broadcasts feeding a batched ``dot_general`` — E×
+        the dense dW FLOPs (the 3.4× ragged-MoE backward of BASELINE
+        round 5); the grouped-dW kernel path (``ops.moe_kernel``) never
+        builds those broadcasts and stays silent.
 
 The pass is backend-free: everything works on abstract values on CPU.
 """
@@ -208,6 +214,61 @@ def _check_upcasts(jaxpr, entrypoint: str, findings: list[Finding]) -> None:
             ))
 
 
+def _check_ragged_transpose(jaxpr, entrypoint: str,
+                            findings: list[Finding]) -> None:
+    """J109 within one jaxpr level: ``lax.ragged_dot``'s transpose rule
+    left in a backward. The stock VJP materializes BOTH dW operands as
+    ``[E, P, ·]`` range-masked broadcasts (``select_n`` of a
+    ``broadcast_in_dim`` over dims (1, 2) of a rank-2 array) and
+    contracts them with a batched ``dot_general`` over the P dim — E×
+    the dense dW FLOPs plus an E-fold activation materialization. The
+    grouped-dW path (ops.moe_kernel) never builds those broadcasts, so
+    it stays silent; only levels that also contain a ``ragged_dot``
+    (i.e. an actual ragged-MoE backward) are considered."""
+    if not any(e.primitive.name == "ragged_dot" for e in jaxpr.eqns):
+        return
+    producers = {id(v): e for e in jaxpr.eqns for v in e.outvars}
+
+    def chase(var):
+        eqn = producers.get(id(var))
+        while eqn is not None and eqn.primitive.name == "convert_element_type":
+            eqn = producers.get(id(eqn.invars[0]))
+        return eqn
+
+    def is_masked_bcast(var) -> bool:
+        eqn = chase(var)
+        if eqn is None or eqn.primitive.name != "select_n":
+            return False
+        for v in eqn.invars:
+            p = chase(v)
+            if (p is not None and p.primitive.name == "broadcast_in_dim"
+                    and tuple(p.params.get("broadcast_dimensions", ())) == (1, 2)
+                    and getattr(getattr(p.invars[0], "aval", None), "ndim",
+                                None) == 2):
+                return True
+        return False
+
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name != "dot_general":
+            continue
+        dims = eqn.params.get("dimension_numbers")
+        if dims != (((1,), (1,)), ((0,), (0,))):
+            continue
+        if any(getattr(getattr(v, "aval", None), "ndim", 0) != 3
+               for v in eqn.invars[:2]):
+            continue
+        if is_masked_bcast(eqn.invars[0]) and is_masked_bcast(eqn.invars[1]):
+            f, ln = _src_loc(eqn)
+            e_dim = eqn.invars[0].aval.shape[0]
+            findings.append(Finding(
+                "J109",
+                f"ragged_dot grouped-transpose dW: batched dot_general over "
+                f"two [{e_dim}, P, ·] range-masked broadcasts — {e_dim}× the "
+                f"dense dW FLOPs in the backward",
+                file=f, line=ln, entrypoint=entrypoint,
+            ))
+
+
 def _fused_xent_seed(eqn) -> dict[int, tuple[str, ...]]:
     """J107 taint seed for one shard_map equation: body invars whose
     LAST dimension the in_names shard, mapped to the sharding axes."""
@@ -363,6 +424,7 @@ def _walk(obj, bound: frozenset[str], entrypoint: str,
     jaxpr, consts = _inner_jaxpr(obj)
     _check_consts(consts, entrypoint, findings)
     _check_upcasts(jaxpr, entrypoint, findings)
+    _check_ragged_transpose(jaxpr, entrypoint, findings)
     for eqn in jaxpr.eqns:
         name = eqn.primitive.name
         if name in COLLECTIVE_PRIMS:

@@ -138,6 +138,7 @@ class TransformerBlock(Module):
     moe_capacity_factor: float = 2.0
     moe_top_k: int = 1
     moe_dispatch: str = "gather"
+    moe_ragged_dw: str = "grouped"  # ragged backward: grouped-dW kernel / stock transpose
     # Fuse the block's ln2 junction (x + attn_out → LayerNorm) into one
     # add+LN Pallas kernel per direction. This is the PIPELINE-stage form
     # of the LM's deferred trunk: the block keeps its shape-preserving
@@ -181,6 +182,7 @@ class TransformerBlock(Module):
                 top_k=self.moe_top_k,
                 axis_name=self.moe_axis,
                 dispatch=self.moe_dispatch,
+                ragged_dw=self.moe_ragged_dw,
                 dtype=self.dtype,
             )
         else:
@@ -347,6 +349,7 @@ class TransformerLM(Module):
     moe_capacity_factor: float = 2.0
     moe_top_k: int = 1
     moe_dispatch: str = "gather"
+    moe_ragged_dw: str = "grouped"  # ragged backward: grouped-dW kernel / stock transpose
     dtype: Any = jnp.float32
     # Fused residual-add + LayerNorm junctions (tpudml.ops.layernorm_kernel
     # .fused_add_layernorm): the trunk defers each block's closing residual
@@ -391,6 +394,7 @@ class TransformerLM(Module):
             moe_capacity_factor=self.moe_capacity_factor,
             moe_top_k=self.moe_top_k,
             moe_dispatch=self.moe_dispatch,
+            moe_ragged_dw=self.moe_ragged_dw,
             dtype=self.dtype,
         )
 

@@ -41,6 +41,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from tpudml.nn.layers import Module, _uniform_fan_in
+from tpudml.ops.moe_kernel import ragged_ffn
 
 
 def _pad0(rows):
@@ -162,6 +163,13 @@ class MoELayer(Module):
     # grouped matmuls; no capacity, no drops, no padded slots (single-
     # shard only: EP's all_to_all needs the static capacity buffers).
     dispatch: str = "gather"
+    # Backward for the ragged FFN's weight gradients. "grouped" routes
+    # dW1/dW2 through ops.moe_kernel.ragged_ffn (Pallas grouped-dW on
+    # TPU, reference segment-einsum elsewhere — cost ∝ tokens).
+    # "stock" keeps lax.ragged_dot's own transpose (an E-scaled masked
+    # matmul — the 3.4× backward of BASELINE round 5) for A/B runs;
+    # the analyzer flags it as J109.
+    ragged_dw: str = "grouped"
 
     def __post_init__(self):
         if not 1 <= self.top_k <= self.num_experts:
@@ -171,6 +179,10 @@ class MoELayer(Module):
         if self.dispatch not in ("gather", "einsum", "ragged"):
             raise ValueError(
                 f"dispatch must be 'gather', 'einsum', or 'ragged', got {self.dispatch!r}"
+            )
+        if self.ragged_dw not in ("grouped", "stock"):
+            raise ValueError(
+                f"ragged_dw must be 'grouped' or 'stock', got {self.ragged_dw!r}"
             )
         if self.dispatch == "ragged" and self.axis_name is not None:
             raise ValueError(
@@ -345,13 +357,27 @@ class MoELayer(Module):
         # ragged_dot wants matching operand dtypes; promote like einsum would.
         ct = jnp.promote_types(x_sorted.dtype, w["w1"].dtype)
         onehot = jax.nn.one_hot(eids[order], e, dtype=ct)  # [P, E]
-        hidden = jax.nn.relu(
-            lax.ragged_dot(x_sorted.astype(ct), w["w1"].astype(ct), group_sizes)
-            + onehot @ w["b1"].astype(ct)
-        )
-        out_sorted = lax.ragged_dot(
-            hidden, w["w2"].astype(ct), group_sizes
-        ) + onehot @ w["b2"].astype(ct)
+        if self.ragged_dw == "grouped":
+            # custom_vjp FFN: dW1/dW2 via the grouped-dW kernel (one row
+            # walk, f32 accumulation) instead of ragged_dot's E-scaled
+            # masked-matmul transpose; dx/dh stay ragged_dot forward-form.
+            out_sorted = ragged_ffn(
+                x_sorted.astype(ct),
+                w["w1"].astype(ct),
+                w["b1"].astype(ct),
+                w["w2"].astype(ct),
+                w["b2"].astype(ct),
+                onehot,
+                group_sizes,
+            )
+        else:  # "stock": lax.ragged_dot's own transpose, kept for A/B.
+            hidden = jax.nn.relu(
+                lax.ragged_dot(x_sorted.astype(ct), w["w1"].astype(ct), group_sizes)
+                + onehot @ w["b1"].astype(ct)
+            )
+            out_sorted = lax.ragged_dot(
+                hidden, w["w2"].astype(ct), group_sizes
+            ) + onehot @ w["b2"].astype(ct)
         # Gate-weighted un-sort: the same injective-map combine as the
         # gather dispatch, with every choice kept (w_eff = gates).
         return _combine_rows(out_sorted, gates, flat_dst, token_src).astype(

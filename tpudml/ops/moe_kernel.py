@@ -1,0 +1,284 @@
+"""Grouped-dW Pallas kernel for the dropless ragged MoE backward.
+
+``lax.ragged_dot``'s transpose rule materializes BOTH operands as
+``[E, P, .]`` range-masked broadcasts and contracts them with a batched
+``dot_general`` — an E-scaled masked matmul (E x the dense dW FLOPs plus
+an E-fold activation blow-up).  That one equation is the whole reason
+``dispatch="ragged"`` trailed gather by 10-16% end-to-end (BASELINE.md
+round-5: 1.105 ms fwd+bwd at E=8 vs 0.327 ms dense on the [16k,512] x
+[512,2048] probe — 3.4x).
+
+The fix exploits what the ragged layout already guarantees: rows are
+argsorted by expert, so expert ``e`` owns the contiguous row slab
+``[offsets[e], offsets[e+1])``.  ``grouped_dw`` walks row tiles exactly
+once, accumulates ``x_slab^T @ g_slab`` in an f32 VMEM scratch, and
+flushes to ``dW[e]`` at each group boundary — cost proportional to total
+tokens, independent of E.  The schedule is the MegaBlocks tgmm schedule
+(grid = row-tile *visits*; a tile shared by two experts is visited once
+per expert with complementary row masks) adapted to a fully static grid:
+rows are padded by one extra tile so the ``visits = tiles + E`` bound is
+exact and metadata padding lands on an unowned zero tile.
+
+``ragged_ffn`` wraps the two-matmul expert FFN in a ``custom_vjp`` whose
+backward uses ``grouped_dw`` for both weight gradients (dx/dh reuse
+``lax.ragged_dot`` forward-form, which was never the problem).  On
+non-TPU backends the public entry points dispatch to differentiable
+reference math (segment one-hot einsum — no masked broadcasts, so the
+J109 analyzer rule stays silent on the fixed path); ``interpret=True``
+forces the Pallas interpreter for kernel parity tests on CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpudml.ops.tiling import round_up
+
+# Default (rows, lhs-cols, rhs-cols) tile. 512 rows amortizes the
+# boundary re-visits; tk x tn = 512 x 1024 keeps the f32 accumulator at
+# 2 MiB of VMEM while covering d=512 / ffn=2048 in 1 x 2 output tiles.
+_DEFAULT_TILING = (512, 512, 1024)
+
+
+def _grouped_tiling(m: int, k: int, n: int,
+                    tiling: Sequence[int] | None) -> tuple[int, int, int]:
+    """Clamp the requested tile to the (padded) problem, keeping TPU
+    alignment: rows/sublanes a multiple of 8, lanes a multiple of 128."""
+    tm, tk, tn = tiling if tiling is not None else _DEFAULT_TILING
+    tm = min(round_up(tm, 8), round_up(m, 8))
+    tk = min(round_up(tk, 128), round_up(k, 128))
+    tn = min(round_up(tn, 128), round_up(n, 128))
+    return tm, tk, tn
+
+
+def _group_metadata(group_sizes, m_pad: int, tm: int, num_groups: int):
+    """Static-shape visit schedule for the grouped row walk.
+
+    Returns ``(group_offsets [E+1], group_ids [V], tile_ids [V])`` with
+    ``V = m_pad//tm + E`` visits: every row tile once, plus one extra
+    visit per group boundary that splits a tile (and one per empty group,
+    so its output still gets zeroed).  ``m_pad`` must leave at least one
+    fully unowned tail tile (rows >= sum(group_sizes)); schedule padding
+    beyond the real visit count resolves to (last group, tail tile)
+    pairs whose row masks are empty, so they contribute nothing.
+    """
+    tiles_m = m_pad // tm
+    num_visits = tiles_m + num_groups
+
+    ends = jnp.cumsum(group_sizes)
+    group_offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), ends.astype(jnp.int32)])
+    starts = group_offsets[:-1]
+
+    rounded_starts = starts // tm * tm
+    rounded_ends = (ends + tm - 1) // tm * tm
+    empty = group_sizes == 0
+    group_tiles = jnp.where(
+        empty, 1, (rounded_ends - rounded_starts) // tm).astype(jnp.int32)
+    group_ids = jnp.repeat(
+        jnp.arange(num_groups, dtype=jnp.int32), group_tiles,
+        total_repeat_length=num_visits)
+
+    # A group whose start is tile-aligned does not add a visit: its first
+    # tile is counted by the plain walk. Unaligned starts (and empty
+    # groups, which still need their zeroing visit) add one visit on the
+    # tile they share.
+    aligned = (starts % tm == 0) & ~empty
+    partial_tile_ids = jnp.where(aligned, tiles_m, starts // tm)
+    tile_visits = (
+        jnp.histogram(partial_tile_ids, bins=tiles_m,
+                      range=(0, tiles_m - 1))[0].astype(jnp.int32) + 1)
+    tile_ids = jnp.repeat(
+        jnp.arange(tiles_m, dtype=jnp.int32), tile_visits,
+        total_repeat_length=num_visits)
+    return group_offsets, group_ids, tile_ids
+
+
+def _grouped_dw_kernel(meta, x_ref, g_ref, out_ref, acc_ref, *, tm: int):
+    group_offsets, group_ids, tile_ids = meta
+    visit = pl.program_id(2)
+    num_visits = pl.num_programs(2)
+    group = group_ids[visit]
+    prev_group = group_ids[jnp.maximum(visit - 1, 0)]
+    next_group = group_ids[jnp.minimum(visit + 1, num_visits - 1)]
+
+    @pl.when((visit == 0) | (group != prev_group))
+    def _zero_acc():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    row0 = tile_ids[visit] * tm
+    rows = row0 + lax.broadcasted_iota(jnp.int32, (tm, 1), 0)
+    mask = (group_offsets[group] <= rows) & (rows < group_offsets[group + 1])
+
+    @pl.when(group_offsets[group] < group_offsets[group + 1])
+    def _accumulate():
+        x_tile = lax.select(
+            jnp.broadcast_to(mask, x_ref.shape), x_ref[...],
+            jnp.zeros_like(x_ref))
+        acc_ref[...] += lax.dot(
+            x_tile.swapaxes(0, 1), g_ref[...],
+            preferred_element_type=jnp.float32)
+
+    @pl.when((visit == num_visits - 1) | (group != next_group))
+    def _store():
+        out_ref[...] = acc_ref[...]
+
+
+def _grouped_dw_pallas(x, g, group_sizes, tiling, interpret: bool):
+    m, k = x.shape
+    _, n = g.shape
+    num_groups = group_sizes.shape[0]
+    tm, tk, tn = _grouped_tiling(m, k, n, tiling)
+    # One extra row tile guarantees an unowned zero tail tile for the
+    # metadata padding to land on.
+    m_pad = round_up(m, tm) + tm
+    k_pad, n_pad = round_up(k, tk), round_up(n, tn)
+
+    x_p = jnp.pad(x, ((0, m_pad - m), (0, k_pad - k)))
+    g_p = jnp.pad(g, ((0, m_pad - m), (0, n_pad - n)))
+    meta = _group_metadata(group_sizes, m_pad, tm, num_groups)
+    num_visits = m_pad // tm + num_groups
+
+    def x_index(k_i, n_i, visit, meta):
+        _, _, tile_ids = meta
+        return tile_ids[visit], k_i
+
+    def g_index(k_i, n_i, visit, meta):
+        _, _, tile_ids = meta
+        return tile_ids[visit], n_i
+
+    def out_index(k_i, n_i, visit, meta):
+        _, group_ids, _ = meta
+        return group_ids[visit], k_i, n_i
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(k_pad // tk, n_pad // tn, num_visits),
+        in_specs=[
+            pl.BlockSpec((tm, tk), x_index),
+            pl.BlockSpec((tm, tn), g_index),
+        ],
+        out_specs=pl.BlockSpec((None, tk, tn), out_index),
+        scratch_shapes=[pltpu.VMEM((tk, tn), jnp.float32)],
+    )
+    dw = pl.pallas_call(
+        functools.partial(_grouped_dw_kernel, tm=tm),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((num_groups, k_pad, n_pad),
+                                       jnp.float32),
+        interpret=interpret,
+    )(meta, x_p, g_p)
+    return dw[:, :k, :n]
+
+
+def _reference_grouped_dw(x, g, group_sizes):
+    """Differentiable XLA reference: segment one-hot einsum over the
+    sorted rows. No range-masked ``[E, P, .]`` broadcast is ever built
+    (each factor stays rank 2), so this path is J109-silent."""
+    num_groups = group_sizes.shape[0]
+    ends = jnp.cumsum(group_sizes)
+    starts = ends - group_sizes
+    rows = jnp.arange(x.shape[0], dtype=group_sizes.dtype)[:, None]
+    seg = ((starts[None, :] <= rows) & (rows < ends[None, :]))
+    seg = seg.astype(jnp.float32)  # [P, E]
+    return jnp.einsum(
+        "pe,pk,pn->ekn", seg, x.astype(jnp.float32), g.astype(jnp.float32),
+        optimize=True)
+
+
+def grouped_dw(x, g, group_sizes, *, tiling: Sequence[int] | None = None,
+               interpret: bool | None = None):
+    """Per-group ``x^T @ g`` over contiguous row slabs.
+
+    ``x [m, k]`` and ``g [m, n]`` hold rows sorted by group;
+    ``group_sizes [E]`` (int) gives each group's slab length (cumsum =
+    slab boundaries; rows beyond ``sum(group_sizes)`` are ignored).
+    Returns ``dW [E, k, n]`` in f32 — one row walk, f32 accumulation,
+    cost proportional to ``m`` rather than ``E * m``.
+
+    ``interpret=None`` auto-dispatches: reference math off-TPU, the
+    Pallas kernel on TPU. ``interpret=True`` forces the Pallas
+    interpreter (CPU parity tests); ``interpret=False`` forces the
+    compiled kernel.
+    """
+    if x.ndim != 2 or g.ndim != 2 or x.shape[0] != g.shape[0]:
+        raise ValueError(
+            f"grouped_dw wants row-aligned 2-D operands, got {x.shape} "
+            f"and {g.shape}")
+    if group_sizes.ndim != 1 or not np.issubdtype(group_sizes.dtype,
+                                                  np.integer):
+        raise ValueError(
+            f"group_sizes must be a 1-D integer array, got "
+            f"{group_sizes.shape} {group_sizes.dtype}")
+    if interpret is None:
+        if jax.default_backend() != "tpu":
+            return _reference_grouped_dw(x, g, group_sizes)
+        interpret = False
+    return _grouped_dw_pallas(x, g, group_sizes.astype(jnp.int32), tiling,
+                              interpret)
+
+
+# ---------------------------------------------------------------------------
+# ragged_ffn: the two-matmul expert FFN with the grouped-dW backward.
+# ---------------------------------------------------------------------------
+
+
+def _ffn_forward(x, w1, b1, w2, b2, onehot, group_sizes):
+    hidden = jax.nn.relu(
+        lax.ragged_dot(x, w1, group_sizes) + onehot @ b1)
+    out = lax.ragged_dot(hidden, w2, group_sizes) + onehot @ b2
+    return out, hidden
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8))
+def ragged_ffn(x, w1, b1, w2, b2, onehot, group_sizes,
+               tiling: Sequence[int] | None = None,
+               interpret: bool | None = None):
+    """Expert FFN ``relu(x @ w1[e] + b1[e]) @ w2[e] + b2[e]`` over rows
+    sorted by expert, with a hand-written backward: dx/dh via
+    ``lax.ragged_dot`` on the swapped weights (forward-form — cheap),
+    dW1/dW2 via :func:`grouped_dw` (f32 accumulation), db via
+    ``onehot^T @ cotangent``.  ``onehot [P, E]`` is the sorted-row
+    expert one-hot (already needed for the biases); its cotangent is
+    returned as zeros — it is integer-derived, the stock VJP dies at
+    ``one_hot`` anyway.
+    """
+    out, _ = _ffn_forward(x, w1, b1, w2, b2, onehot, group_sizes)
+    return out
+
+
+def _ragged_ffn_fwd(x, w1, b1, w2, b2, onehot, group_sizes, tiling,
+                    interpret):
+    out, hidden = _ffn_forward(x, w1, b1, w2, b2, onehot, group_sizes)
+    return out, (x, w1, w2, onehot, group_sizes, hidden)
+
+
+def _ragged_ffn_bwd(tiling, interpret, res, dout):
+    x, w1, w2, onehot, group_sizes, hidden = res
+    ct = dout.dtype
+    dw2 = grouped_dw(hidden, dout, group_sizes, tiling=tiling,
+                     interpret=interpret).astype(w2.dtype)
+    db2 = lax.dot(onehot.swapaxes(0, 1), dout,
+                  preferred_element_type=jnp.float32).astype(ct)
+    dh = lax.ragged_dot(dout, w2.swapaxes(1, 2), group_sizes)
+    dpre = dh * (hidden > 0).astype(ct)
+    dw1 = grouped_dw(x, dpre, group_sizes, tiling=tiling,
+                     interpret=interpret).astype(w1.dtype)
+    db1 = lax.dot(onehot.swapaxes(0, 1), dpre,
+                  preferred_element_type=jnp.float32).astype(ct)
+    dx = lax.ragged_dot(dpre, w1.swapaxes(1, 2), group_sizes)
+    d_onehot = jnp.zeros_like(onehot)
+    d_gs = np.zeros(group_sizes.shape, dtype=jax.dtypes.float0)
+    return (dx.astype(x.dtype), dw1, db1.astype(w1.dtype), dw2,
+            db2.astype(w2.dtype), d_onehot, d_gs)
+
+
+ragged_ffn.defvjp(_ragged_ffn_fwd, _ragged_ffn_bwd)
