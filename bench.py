@@ -594,6 +594,133 @@ def main_moe() -> None:
     print(json.dumps(bench_moe(on_tpu)))
 
 
+def bench_serve(on_tpu) -> dict:
+    """``--serve`` report, two sections:
+
+    (a) the A/B the KV cache exists for — per-token decode step time,
+        cached (ONE token through ``apply_decode`` over a [B, T, Hkv, Dh]
+        cache) vs cacheless (full forward over the whole T-token history
+        per emitted token) at history lengths T ∈ {512, 1024}. Each step
+        is timed individually (warm, median of reps) with a host fetch of
+        the emitted tokens as the sync barrier — per-token latency is a
+        single-dispatch metric, so fori differencing does not apply.
+    (b) engine throughput/latency under the seeded Poisson load
+        generator at fixed QPS points (plus the qps=inf saturation row):
+        tokens/sec, p50/p99 per-token and end-to-end latency.
+    """
+    import math
+    import statistics
+
+    import numpy as np
+
+    from tpudml.models import TransformerLM
+    from tpudml.serve import (
+        ServeConfig, ServingEngine, make_cacheless_decode_step,
+        make_decode_step, poisson_workload,
+    )
+
+    if on_tpu:
+        cfg = dict(vocab_size=32768, embed_dim=512, num_heads=8,
+                   num_kv_heads=2, num_layers=6)
+        slots, reps = 8, 20
+    else:  # CPU dryrun: ratio + wiring sanity, not chip numbers
+        cfg = dict(vocab_size=256, embed_dim=64, num_heads=4,
+                   num_kv_heads=2, num_layers=2)
+        slots, reps = 2, 7
+
+    def timed_median(fn, *args, n=reps):
+        ts = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            out = fn(*args)
+            jax.device_get(out)  # host copy of the tokens = sync barrier
+            ts.append(time.perf_counter() - t0)
+        return statistics.median(ts)
+
+    decode_rows: dict[str, dict] = {}
+    for t_hist in (512, 1024):
+        model = TransformerLM(**cfg, max_len=t_hist, rope=True,
+                              impl="flash" if on_tpu else "full")
+        params, _ = model.init(jax.random.key(0))
+        rng = np.random.default_rng(1)
+
+        # Cached: one token per slot at cache depth t_hist - 1. The step
+        # donates its caches, so thread them through the warmup calls and
+        # time with a fixed re-bound cache state.
+        step = make_decode_step(model)
+        caches = model.init_decode_cache(slots, t_hist)
+        toks = rng.integers(0, cfg["vocab_size"], slots).astype(np.int32)
+        pos = np.full(slots, t_hist - 1, np.int32)
+        for _ in range(2):  # compile + warm
+            _, _, caches = step(params, caches, toks, pos)
+
+        def cached_once():
+            nonlocal caches
+            out, _, caches = step(params, caches, toks, pos)
+            return out
+
+        cached_sec = timed_median(cached_once)
+
+        # Cacheless: the same emitted token pays a full forward over the
+        # entire history (the J110 shape).
+        bad_step = make_cacheless_decode_step(model)
+        history = rng.integers(
+            0, cfg["vocab_size"], (slots, t_hist)).astype(np.int32)
+        for _ in range(2):
+            bad_step(params, history)
+        cacheless_sec = timed_median(bad_step, params, history)
+
+        decode_rows[f"T{t_hist}"] = {
+            "cached_sec_per_token_step": round(cached_sec, 6),
+            "cacheless_sec_per_token_step": round(cacheless_sec, 6),
+            "speedup": round(cacheless_sec / cached_sec, 2),
+        }
+
+    # (b) engine under load. Small horizon so the QPS points finish in
+    # seconds; arrivals are open-loop, so queue depth (not generator
+    # back-pressure) absorbs any engine slowness.
+    serve_model = TransformerLM(**cfg, max_len=128, rope=True,
+                                impl="flash" if on_tpu else "full")
+    serve_params, _ = serve_model.init(jax.random.key(0))
+    qps_rows: dict[str, dict] = {}
+    for qps in (2.0, 4.0, math.inf):
+        eng = ServingEngine(
+            serve_model, serve_params,
+            ServeConfig(slots=4, max_len=128, prefill_chunk=16))
+        reqs, _ = poisson_workload(
+            12, qps, 7, vocab_size=cfg["vocab_size"],
+            prompt_len=(8, 24), new_tokens=(8, 24))
+        rep = eng.run(reqs)
+        lat = rep.latency_summary()
+        qps_rows["saturated" if math.isinf(qps) else f"qps{qps:g}"] = {
+            "tokens_per_sec": round(rep.tokens_per_sec, 2),
+            "per_token_p50_ms": round(lat["per_token_p50_s"] * 1e3, 3),
+            "per_token_p99_ms": round(lat["per_token_p99_s"] * 1e3, 3),
+            "e2e_p50_s": round(lat["e2e_p50_s"], 4),
+            "e2e_p99_s": round(lat["e2e_p99_s"], 4),
+            "decode_steps": rep.decode_steps,
+        }
+
+    return {
+        "metric": "serving_cached_vs_cacheless_decode",
+        "config": {**cfg, "slots": slots},
+        "protocol": "per_call_median",
+        "on_tpu": on_tpu,
+        "decode_step": decode_rows,
+        "serve_load": {
+            "n_requests": 12, "slots": 4, "max_len": 128,
+            "prefill_chunk": 16, "rows": qps_rows,
+        },
+    }
+
+
+def main_serve() -> None:
+    """Driver for ``python bench.py --serve``: prints ONE JSON line, same
+    contract as ``main()``, for the serving comparison."""
+    on_tpu = jax.devices()[0].platform != "cpu"
+    print(json.dumps(bench_serve(on_tpu)))
+
+
 def main_zero1() -> None:
     """Driver for ``python bench.py --zero1``: prints ONE JSON line, same
     contract as ``main()`` but for the ZeRO-1 comparison. Self-provisions
@@ -674,5 +801,7 @@ if __name__ == "__main__":
         main_zero1()
     elif "--moe" in sys.argv[1:]:
         main_moe()
+    elif "--serve" in sys.argv[1:]:
+        main_serve()
     else:
         main()

@@ -1,0 +1,152 @@
+"""Task 6 — prefill/decode LM serving with continuous batching.
+
+Drives ``tpudml.serve.ServingEngine`` over a decoder-only TransformerLM
+with a seeded Poisson arrival stream (open-loop: arrival times are fixed
+before the run, so queueing delay shows up in the latencies instead of
+back-pressuring the generator). The engine runs ONE jitted decode step
+for a fixed batch of ``--slots`` cache rows, prefills prompts in
+``--prefill_chunk``-token chunks, and refills freed slots mid-flight.
+
+Knobs: ``--cache_kind int8`` for the quantized KV cache, ``--tp N`` to
+shard params + cache heads + the decode step over an N-way
+tensor-parallel mesh (reuses the training TP rules — a TP checkpoint
+serves unmodified), ``--qps inf`` for the saturation (closed-queue)
+regime.
+
+Reports generated tokens/sec and p50/p99 per-token, time-to-first-token,
+and end-to-end request latency.
+
+Run: ``python -m tasks.task6_serve --n_requests 16 --qps 4``
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+
+from tpudml.core.dist import assert_same_program, distributed_init
+from tpudml.metrics import MetricsWriter
+from tpudml.models import TransformerLM
+from tpudml.serve import ServeConfig, ServingEngine, poisson_workload
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser()
+    # model
+    p.add_argument("--vocab", type=int, default=256)
+    p.add_argument("--embed_dim", type=int, default=128)
+    p.add_argument("--num_heads", type=int, default=8)
+    p.add_argument("--num_layers", type=int, default=2)
+    p.add_argument("--num_kv_heads", type=int, default=None, help="GQA/MQA")
+    p.add_argument("--no_rope", action="store_true",
+                   help="learned position table instead of rotary")
+    # serving
+    p.add_argument("--slots", type=int, default=4,
+                   help="fixed decode batch: concurrent in-flight sequences")
+    p.add_argument("--max_len", type=int, default=256,
+                   help="cache rows per slot (prompt + generation bound)")
+    p.add_argument("--prefill_chunk", type=int, default=32)
+    p.add_argument("--cache_kind", choices=("f32", "bf16", "int8"),
+                   default="f32")
+    p.add_argument("--tp", type=int, default=0,
+                   help="tensor-parallel ways (0 = single device)")
+    # workload
+    p.add_argument("--n_requests", type=int, default=16)
+    p.add_argument("--qps", type=str, default="4",
+                   help="Poisson arrival rate; 'inf' = all at t=0")
+    p.add_argument("--prompt_len", type=int, nargs=2, default=(8, 48),
+                   metavar=("MIN", "MAX"))
+    p.add_argument("--new_tokens", type=int, nargs=2, default=(8, 32),
+                   metavar=("MIN", "MAX"))
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--log_dir", type=str, default="./logs")
+    return p.parse_args(argv)
+
+
+def build_engine(args) -> ServingEngine:
+    model = TransformerLM(
+        vocab_size=args.vocab,
+        embed_dim=args.embed_dim,
+        num_heads=args.num_heads,
+        num_layers=args.num_layers,
+        num_kv_heads=args.num_kv_heads,
+        max_len=args.max_len,
+        rope=not args.no_rope,
+    )
+    params, _ = model.init(jax.random.key(args.seed))
+    cfg = ServeConfig(
+        slots=args.slots, max_len=args.max_len,
+        prefill_chunk=args.prefill_chunk, cache_kind=args.cache_kind,
+    )
+    if args.tp:
+        from tpudml.core.config import MeshConfig
+        from tpudml.core.dist import make_mesh
+
+        if len(jax.devices()) < args.tp:
+            raise RuntimeError(
+                f"--tp {args.tp} needs {args.tp} devices, have "
+                f"{len(jax.devices())}")
+        mesh = make_mesh(MeshConfig({"model": args.tp}),
+                         jax.devices()[: args.tp])
+        return ServingEngine(model, params, cfg, mesh=mesh,
+                             axis_name="model")
+    return ServingEngine(model, params, cfg)
+
+
+def run(args) -> dict:
+    distributed_init()
+    rank_invariant = {k: v for k, v in vars(args).items() if k != "log_dir"}
+    assert_same_program(repr(sorted(rank_invariant.items())), "task6 args")
+
+    qps = float(args.qps)
+    engine = build_engine(args)
+    requests, ledger = poisson_workload(
+        args.n_requests, qps, args.seed, vocab_size=args.vocab,
+        prompt_len=tuple(args.prompt_len),
+        new_tokens=tuple(args.new_tokens),
+    )
+    report = engine.run(requests)
+
+    owed = sum(o["max_new_tokens"] for o in ledger.values())
+    assert report.generated_tokens == owed, (
+        f"token accounting mismatch: generated {report.generated_tokens}, "
+        f"ledger owes {owed}")
+    lat = report.latency_summary()
+    writer = MetricsWriter(args.log_dir, run_name="task6-serve")
+    writer.add_scalar("Serve Tokens Per Sec", report.tokens_per_sec, 0)
+    writer.add_scalar("Per-Token p50 (ms)", lat["per_token_p50_s"] * 1e3, 0)
+    writer.add_scalar("Per-Token p99 (ms)", lat["per_token_p99_s"] * 1e3, 0)
+    writer.add_scalar("E2E p99 (s)", lat["e2e_p99_s"], 0)
+    writer.close()
+
+    refills = sum(1 for e in report.events if e[0] == "admit" and e[3] > 0)
+    print(
+        f"[serve{'/tp' + str(args.tp) if args.tp else ''}/"
+        f"{args.cache_kind}] {args.n_requests} requests @ "
+        f"qps={args.qps}, {args.slots} slots: "
+        f"{report.generated_tokens} tokens in {report.wall_time:.2f}s "
+        f"({report.tokens_per_sec:,.1f} tok/s, {report.decode_steps} decode "
+        f"steps, {refills} mid-flight refills)"
+    )
+    print(
+        f"  per-token p50/p99: {lat['per_token_p50_s'] * 1e3:.2f}/"
+        f"{lat['per_token_p99_s'] * 1e3:.2f} ms | ttft p50/p99: "
+        f"{lat['ttft_p50_s'] * 1e3:.1f}/{lat['ttft_p99_s'] * 1e3:.1f} ms | "
+        f"e2e p50/p99: {lat['e2e_p50_s']:.3f}/{lat['e2e_p99_s']:.3f} s"
+    )
+    return {
+        "tokens_per_sec": report.tokens_per_sec,
+        "decode_steps": report.decode_steps,
+        "generated_tokens": report.generated_tokens,
+        "mid_flight_refills": refills,
+        **lat,
+    }
+
+
+def main(argv=None):
+    return run(parse_args(argv))
+
+
+if __name__ == "__main__":
+    main()

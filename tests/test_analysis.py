@@ -305,6 +305,43 @@ def test_j109_ragged_transpose_backward(ragged_dw):
         assert fired == [], fired
 
 
+def test_j110_cacheless_decode_fires_and_cached_is_silent():
+    """J110 fires on a decode-marked program that recomputes the full
+    [T, T] attention per emitted token (make_cacheless_decode_step, the
+    serving bench's A/B baseline) and stays silent on the KV-cached step
+    whose softmax is [B, H, 1, L]."""
+    from tpudml.models import TransformerLM
+    from tpudml.serve import (ServeConfig, ServingEngine,
+                              make_cacheless_decode_step)
+
+    lm = TransformerLM(vocab_size=32, embed_dim=16, num_heads=2,
+                       num_layers=2, max_len=16, rope=True)
+    params, _ = lm.init(jax.random.key(0))
+    bad = analyze_callable(
+        make_cacheless_decode_step(lm), (params, np.zeros((2, 12), np.int32)),
+        "j110-cacheless")
+    fired = [f for f in bad if f.rule == "J110"]
+    assert len(fired) == 1, bad  # one finding per marked program, not per layer
+    assert "full-sequence" in fired[0].message and fired[0].hint
+
+    eng = ServingEngine(
+        lm, params, ServeConfig(slots=2, max_len=16, prefill_chunk=4))
+    good = analyze_callable(
+        eng._decode,
+        (params, eng.caches, np.zeros(2, np.int32), np.zeros(2, np.int32)),
+        "j110-cached")
+    assert [f for f in good if f.rule == "J110"] == [], good
+
+
+def test_j110_marker_name_matches_serve_module():
+    """Same drift pin as J107: the analyzer's string literal must equal
+    the marker the serving engine jits its decode step under."""
+    from tpudml.analysis import jaxpr_pass
+    from tpudml.serve import engine
+
+    assert jaxpr_pass.SERVE_DECODE_NAME == engine.SERVE_DECODE_MARKER
+
+
 def test_j100_trace_failure_becomes_finding():
     def broken(x):
         return x + jnp.ones((x.shape[0] + 1,))  # shape mismatch at trace
@@ -330,7 +367,7 @@ def test_donation_parser_reads_aliasing():
 @pytest.mark.parametrize(
     "name",
     ["task2_dp", "dp_zero1", "fsdp", "pp_gpipe", "tp_fused", "fsdp_fused",
-     "moe_ragged"])
+     "moe_ragged", "serve_decode"])
 def test_entrypoints_trace_on_cpu(name):
     """The acceptance floor: the DP, FSDP, and pipeline steps trace and
     analyze without TPU hardware, with no error-severity findings and

@@ -13,7 +13,7 @@ import numpy as np
 import pytest
 
 from tpudml.core.config import MeshConfig
-from tpudml.core.dist import make_mesh
+from tpudml.core.dist import make_mesh, shard_index_key
 from tpudml.core.prng import seed_key
 from tpudml.nn import Activation, Dense, Sequential
 from tpudml.nn.losses import softmax_cross_entropy
@@ -102,7 +102,8 @@ def test_trajectory_descends_and_replicas_stay_synced(batch):
     leaf = jax.tree.leaves(ts.params["stages"])[0]
     shard_by_stage = {}
     for s in leaf.addressable_shards:
-        key = s.index
+        # Shard.index is a tuple of slices — unhashable before py3.12.
+        key = shard_index_key(s.index)
         got = np.asarray(s.data)
         if key in shard_by_stage:
             np.testing.assert_array_equal(shard_by_stage[key], got)

@@ -26,6 +26,7 @@ pp_gpipe        parallel/pp.py GPipe                   task5 --mode pp
 cp_ring         parallel/cp.py ContextParallel         task5 --mode cp
 ep_moe          parallel/ep.py ExpertParallel          task5 --mode ep
 lm_bf16         make_train_step on a bf16 LM           task5 --mode single
+serve_decode    serve/engine.py make_decode_step       task6
 ==============  =====================================  ================
 """
 
@@ -279,6 +280,33 @@ def build_moe_ragged() -> list[Program]:
     return [Program("moe_ragged", step, (ts, x, y))]
 
 
+def build_serve_decode() -> list[Program]:
+    """The serving engine's jitted per-token decode step — the surface
+    J110 guards. The cache-carrying step must trace J110-silent (its
+    softmax is [B, H, 1, L]); ``make_cacheless_decode_step`` is the
+    rule's firing fixture (covered in tests/test_analysis.py, not
+    registered as an entrypoint)."""
+    import jax
+    from tpudml.serve import ServeConfig, ServingEngine
+
+    lm = _tiny_lm(rope=True, num_kv_heads=1)
+    params, _ = lm.init(jax.random.key(0))
+    eng = ServingEngine(
+        lm, params,
+        ServeConfig(slots=2, max_len=8, prefill_chunk=4),
+    )
+    np = _np()
+    tokens = np.zeros(2, np.int32)
+    pos = np.zeros(2, np.int32)
+    return [Program(
+        "serve_decode", eng._decode, (params, eng.caches, tokens, pos),
+        # The donated buffers are the per-layer KV caches — a few KiB at
+        # this toy size, far under the J106 large-input threshold — so
+        # lowering-level donation analysis has nothing to check here.
+        expects_donation=False,
+    )]
+
+
 #: name -> builder; order is reporting order.
 ENTRYPOINTS: dict[str, Callable[[], list[Program]]] = {
     "task1_single": build_task1_single,
@@ -293,6 +321,7 @@ ENTRYPOINTS: dict[str, Callable[[], list[Program]]] = {
     "ep_moe": build_ep_moe,
     "moe_ragged": build_moe_ragged,
     "lm_bf16": build_lm_bf16,
+    "serve_decode": build_serve_decode,
 }
 
 

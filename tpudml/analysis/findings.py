@@ -40,6 +40,9 @@ RULES: dict[str, tuple[str, str]] = {
     "J109": (WARN, "ragged_dot's E-scaled grouped-transpose dW in the "
                    "backward (E× the dense dW FLOPs via masked [E, P, ·] "
                    "broadcasts)"),
+    "J110": (WARN, "decode-marked program recomputes full-sequence "
+                   "attention per emitted token (O(T²) softmax inside the "
+                   "per-token step)"),
     "A201": (WARN, "Python for/if over a traced (jnp/lax) value"),
     "A202": (WARN, "jax.random key consumed more than once without split"),
     "A203": (WARN, "epoch loop iterates a loader without set_epoch"),
@@ -63,6 +66,9 @@ HINTS: dict[str, str] = {
     "J109": "route the ragged FFN through ops.moe_kernel.ragged_ffn "
             "(MoELayer ragged_dw='grouped'): grouped-dW accumulates each "
             "expert's contiguous slab at cost ∝ tokens",
+    "J110": "carry a KV cache through the decode loop "
+            "(serve.ServingEngine / TransformerLM.apply_decode) so each "
+            "step attends [B, H, 1, L] over cached K/V",
     "A201": "use lax.cond/lax.fori_loop/jnp.where, or materialize with "
             "float(...) first if this is host-side code",
     "A202": "key, sub = jax.random.split(key) before the second use",

@@ -36,6 +36,13 @@ multi-host slice:
         the dense dW FLOPs (the 3.4× ragged-MoE backward of BASELINE
         round 5); the grouped-dW kernel path (``ops.moe_kernel``) never
         builds those broadcasts and stays silent.
+- J110  a decode-marked program (``tpudml.serve``'s jitted per-token
+        step) that recomputes FULL-sequence attention per emitted token:
+        a softmax ``exp`` over scores whose trailing two (query, key)
+        dims are both > 1 means the step pays O(T²) attention for one
+        token — generation goes quadratic-per-token instead of reading
+        the KV cache. The cache-carrying step's scores are [B, H, 1, L]
+        (query dim 1) and stay silent.
 
 The pass is backend-free: everything works on abstract values on CPU.
 """
@@ -81,6 +88,11 @@ LARGE_CONST_BYTES = 1 << 20  # 1 MiB
 # pairing is pinned by test_analysis.
 FUSED_XENT_NAME = "_fused_xent_unsharded"
 SHARDED_XENT_NAME = "_fused_xent_sharded"
+
+# The serving decode step is jitted under this marker name (J110).
+# Mirrors SERVE_DECODE_MARKER in tpudml/serve/engine.py — a string
+# literal for the same reason; the pairing is pinned by test_analysis.
+SERVE_DECODE_NAME = "_serve_decode_step"
 
 # Primitives a last-dim sharding survives on the way from a shard_map
 # body invar to the fused head's w operand (J107 taint propagation).
@@ -340,6 +352,51 @@ def _check_fused_xent(obj, tainted: dict[int, tuple[str, ...]],
                 tainted[id(out)] = axes
 
 
+def _find_wide_softmax_exp(obj):
+    """First ``exp`` equation (recursing through sub-jaxprs) whose operand
+    keeps BOTH trailing dims > 1 — the [.., T, T] attention-probability
+    tensor of a full-sequence softmax. A cache-reading decode step's
+    softmax runs on [B, H, 1, L] scores (one query row per emitted
+    token), so its exp never matches."""
+    jaxpr, _ = _inner_jaxpr(obj)
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "exp":
+            shape = tuple(
+                getattr(getattr(eqn.invars[0], "aval", None), "shape", ())
+            )
+            if len(shape) >= 2 and shape[-1] > 1 and shape[-2] > 1:
+                return eqn, shape
+        for sub, _extra in _sub_jaxprs(eqn):
+            hit = _find_wide_softmax_exp(sub)
+            if hit is not None:
+                return hit
+    return None
+
+
+def _check_cacheless_decode(eqn, entrypoint: str,
+                            findings: list[Finding]) -> None:
+    """J110 for one decode-marked pjit equation: the per-token step
+    contains a full-sequence attention softmax, i.e. it recomputes every
+    previous position's scores to emit ONE token. One finding per marked
+    program (the per-layer repeats add nothing)."""
+    body = eqn.params.get("jaxpr")
+    if body is None:
+        return
+    hit = _find_wide_softmax_exp(body)
+    if hit is None:
+        return
+    exp_eqn, shape = hit
+    f, ln = _src_loc(exp_eqn)
+    findings.append(Finding(
+        "J110",
+        f"decode step recomputes full-sequence attention per emitted "
+        f"token: softmax exp over {list(shape)} scores (query and key "
+        f"dims both > 1) inside the per-token program — O(T²) per token; "
+        f"carry a KV cache (tpudml.serve) so decode attends [B, H, 1, L]",
+        file=f, line=ln, entrypoint=entrypoint,
+    ))
+
+
 def _scan_update_collectives(obj, axes: tuple[str, ...], acc: dict) -> None:
     """Recursively collect, for J108: the output shapes of tensor psums
     over any of ``axes`` (the allreduced gradients), and whether any
@@ -463,6 +520,8 @@ def _walk(obj, bound: frozenset[str], entrypoint: str,
                     f"sequences — {desc}",
                     file=f, line=ln, entrypoint=entrypoint,
                 ))
+        if name == "pjit" and str(eqn.params.get("name", "")) == SERVE_DECODE_NAME:
+            _check_cacheless_decode(eqn, entrypoint, findings)
         if name == "shard_map":
             seed = _fused_xent_seed(eqn)
             if seed:
@@ -489,7 +548,7 @@ def _check_consts(consts, entrypoint: str, findings: list[Finding]) -> None:
 
 
 def analyze_closed_jaxpr(closed, entrypoint: str = "") -> list[Finding]:
-    """All jaxpr-level findings (J101-J105, J107) for one traced
+    """All jaxpr-level findings (J101-J105, J107-J110) for one traced
     program."""
     findings: list[Finding] = []
     _walk(closed, frozenset(), entrypoint, findings)

@@ -140,3 +140,13 @@ def make_mesh(cfg: MeshConfig | None = None, devices=None) -> Mesh:
     if total != devices.size:
         raise ValueError(f"mesh {sizes} wants {total} devices, have {devices.size}")
     return Mesh(devices.reshape(tuple(sizes.values())), tuple(sizes.keys()))
+
+
+def shard_index_key(index) -> tuple:
+    """Hashable key for a ``Shard.index`` (a tuple of ``slice`` objects —
+    unhashable before Python 3.12). Use it to group/dedupe addressable
+    shards by the array region they cover."""
+    return tuple(
+        (s.start, s.stop, s.step) if isinstance(s, slice) else s
+        for s in index
+    )

@@ -530,6 +530,116 @@ class TransformerLM(Module):
         # and argmax/accuracy are dtype-insensitive.
         return logits, new_state
 
+    # ----------------------------------------------------- serving paths
+    # KV-cached incremental decode + chunked prefill (tpudml.serve). Both
+    # run the UNFUSED pre-LN math with train=False — exactly _trunk's
+    # composition — so greedy decode is logit-exact against apply() (the
+    # tests/test_serve.py parity contract). MoE is rejected: routing a
+    # single token re-runs the full dispatch machinery for no cache
+    # reuse; PP likewise has no serve composition (docs/API.md).
+
+    def _serve_guard(self):
+        if self.moe_experts:
+            raise NotImplementedError(
+                "serve decode does not compose with MoE blocks yet"
+            )
+        if self._use_fused_ln():
+            # fused_add_layernorm is a throughput fusion for [B, T≫1, d]
+            # streams; a one-token decode step gains nothing and the
+            # unfused math is the parity reference. Reject rather than
+            # silently diverge from the training-time configuration.
+            raise NotImplementedError(
+                "serve decode runs the unfused-LN math; build the serving "
+                "model with fused_ln=False"
+            )
+        if self.seq_sharded:
+            raise ValueError("serve decode requires seq_sharded=False")
+
+    def init_decode_cache(self, batch: int, max_len: int | None = None,
+                          kind: str = "f32"):
+        """Per-layer KV caches for ``batch`` decode slots: a tuple of
+        ``num_layers`` ``serve.cache.KVCache`` pytrees, each
+        [batch, max_len, kv_heads, head_dim] (GQA shrinks the head axis;
+        TP shards it). ``kind`` selects f32/bf16/int8 storage."""
+        from tpudml.serve.cache import init_cache
+
+        self._serve_guard()
+        max_len = self.max_len if max_len is None else max_len
+        if not self.rope and max_len > self.max_len:
+            raise ValueError(
+                f"cache max_len {max_len} exceeds the position table "
+                f"({self.max_len}); only RoPE models extrapolate"
+            )
+        head_dim = self.embed_dim // self.num_heads
+        kv_heads = self.num_kv_heads or self.num_heads
+        return tuple(
+            init_cache(batch, max_len, kv_heads, head_dim, kind)
+            for _ in range(self.num_layers)
+        )
+
+    def _decode_embed(self, params, tokens, pos):
+        """[B] tokens at per-slot positions ``pos`` [B] → [B, 1, d]."""
+        h = params["tok_embed"][tokens][:, None, :]
+        if not self.rope:
+            h = h + params["pos_embed"][pos][:, None, :]
+        return h
+
+    def _serve_blocks(self, params, caches, h, attend):
+        """Shared block loop of both serving paths: pre-LN attention (via
+        ``attend(attn_module, block_params, cache, y)``) and the dense
+        FFN, threading per-layer caches."""
+        block = self._block()
+        parts = block._parts()
+        new_caches = []
+        for i, cache in enumerate(caches):
+            p = params[f"block{i}"]
+            y = parts["ln1"](p["ln1"], h)
+            a, cache = attend(parts["attn"], p["attn"], cache, y)
+            h = h + a
+            y2 = parts["ln2"](p["ln2"], h)
+            f = parts["fc2"](p["fc2"], jax.nn.gelu(parts["fc1"](p["fc1"], y2)))
+            h = h + f
+            new_caches.append(cache)
+        return h, tuple(new_caches)
+
+    def apply_decode(self, params, caches, tokens, pos):
+        """One incremental decode step: ``tokens`` [B] at per-slot
+        positions ``pos`` [B] → (logits [B, V], updated caches). Each
+        slot's K/V land in its cache row at ``pos``; attention covers
+        the slot's written prefix only. Cost per emitted token is O(L·d)
+        — never the O(T²) training kernel."""
+        self._serve_guard()
+        params = self._cast_params(params)
+        h = self._decode_embed(params, tokens, pos)
+        h, new_caches = self._serve_blocks(
+            params, caches, h,
+            lambda attn, p, cache, y: attn.apply_decode(p, cache, y, pos),
+        )
+        logits = self._head()({k: params[k] for k in ("ln_f", "head")}, h)
+        return logits[:, 0, :], new_caches
+
+    def apply_prefill(self, params, caches, chunk, slot, start: int):
+        """Prefill one chunk of one slot's prompt: ``chunk`` [1, C]
+        tokens at global positions [start, start+C) → updated caches.
+        ``start`` is static (one compiled program per chunk index); no
+        logits — the engine feeds the prompt's LAST token through
+        ``apply_decode`` to emit the first generated token."""
+        self._serve_guard()
+        params = self._cast_params(params)
+        c = chunk.shape[1]
+        h = params["tok_embed"][chunk]
+        if not self.rope:
+            if start + c > self.max_len:
+                raise ValueError(
+                    f"prefill window {start + c} exceeds max_len {self.max_len}"
+                )
+            h = h + params["pos_embed"][start:start + c][None]
+        _, new_caches = self._serve_blocks(
+            params, caches, h,
+            lambda attn, p, cache, y: attn.apply_prefill(p, cache, y, slot, start),
+        )
+        return new_caches
+
     def apply_features(self, params, state, tokens, *, train=False, rng=None):
         """Pre-head features: embed → blocks → final LayerNorm, WITHOUT
         the vocab projection — the input contract of the fused

@@ -52,12 +52,18 @@ def rotary_embedding(
     sequence axis each device passes its shard's offset positions, and
     because RoPE encodes relative position in the q·k phase difference,
     ring/Ulysses attention then needs no further position handling.
+    A [B, T] ``positions`` gives each batch row its own positions — the
+    continuous-batching decode regime, where every cache slot sits at
+    its own depth.
     """
     d = x.shape[-1] // 2
     freqs = base ** (-jnp.arange(d, dtype=jnp.float32) / d)  # [d]
-    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # [T, d]
-    cos = jnp.cos(angles)[None, :, None, :].astype(x.dtype)
-    sin = jnp.sin(angles)[None, :, None, :].astype(x.dtype)
+    # [T, d] or [B, T, d]; the batch dim (if any) then aligns with x's.
+    angles = positions.astype(jnp.float32)[..., :, None] * freqs
+    cos = jnp.cos(angles)[..., :, None, :].astype(x.dtype)
+    sin = jnp.sin(angles)[..., :, None, :].astype(x.dtype)
+    if positions.ndim == 1:
+        cos, sin = cos[None], sin[None]
     x1, x2 = x[..., :d], x[..., d:]
     return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
 
@@ -91,6 +97,65 @@ def dot_product_attention(
         s = jnp.where(mask[None, None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def decode_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, pos: jax.Array
+) -> jax.Array:
+    """Single-token decode attention: q [B, 1, H, D] over a full cache
+    k/v [B, L, H, D] with per-slot current positions ``pos`` [B].
+
+    The mask ``k_pos <= pos[b]`` replaces the causal triangle: each slot
+    attends exactly its own written prefix (the current token's K/V are
+    written at ``pos`` BEFORE this call), and unwritten cache rows are
+    excluded the same way future tokens are in training — NEG_INF before
+    the f32 softmax, so they carry exactly zero weight and the valid
+    rows produce the same statistics as the training kernel's masked
+    row. O(L) per emitted token; the O(T²) training kernels never run."""
+    d = q.shape[-1]
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    mask = jnp.arange(k.shape[1])[None, :] <= pos[:, None]  # [B, L]
+    s = jnp.where(mask[:, None, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _chunk_flash_window(
+    q: jax.Array, k: jax.Array, v: jax.Array, start: int
+) -> jax.Array:
+    """Prefill-chunk attention on TPU via the flash kernel: q [B, C, H, D]
+    at global offset ``start`` over the window k/v [B, start+C, H, D]
+    (``start`` static, a multiple of C).
+
+    The flash kernels fold K/V at the QUERY length, so the window runs as
+    ``start/C + 1`` equal-length block calls — every block below the
+    chunk is fully visible (causal=False), the diagonal block masks
+    locally — merged with the same online log-sum-exp combination the
+    ring forward uses. Identical work to one causal flash over the
+    window; no O(T²) recompute of earlier chunks."""
+    from tpudml.ops.attention_kernel import flash_forward_lse
+
+    b, c, h, d = q.shape
+    n = start // c + 1
+    num = jnp.zeros((b, c, h, d), jnp.float32)
+    m = jnp.full((b, h, c), NEG_INF, jnp.float32)
+    den = jnp.zeros((b, h, c), jnp.float32)
+    for j in range(n):
+        kb = k[:, j * c:(j + 1) * c]
+        vb = v[:, j * c:(j + 1) * c]
+        o_b, lse_b = flash_forward_lse(q, kb, vb, causal=(j == n - 1))
+        m_new = jnp.maximum(m, lse_b)
+        c_old = jnp.exp(m - m_new)
+        c_new = jnp.exp(lse_b - m_new)
+        num = (
+            num * c_old.transpose(0, 2, 1)[..., None]
+            + o_b * c_new.transpose(0, 2, 1)[..., None]
+        )
+        den = den * c_old + c_new
+        m = m_new
+    return (num / den.transpose(0, 2, 1)[..., None]).astype(q.dtype)
 
 
 @dataclass(frozen=True)
@@ -225,3 +290,90 @@ class MultiHeadAttention(Module):
             raise ValueError(f"unknown attention impl {self.impl!r}")
         o = o.reshape(b, t, self.embed_dim)
         return o @ params["out"]["kernel"] + params["out"]["bias"], state
+
+    # ----------------------------------------------------- serving paths
+    # Incremental decode + chunked prefill over a tpudml.serve KVCache.
+    # Same projections/RoPE/GQA-repeat/softmax math as apply() — the
+    # greedy-decode parity tests pin logit-exactness against it — but
+    # attention reads K/V from the cache instead of recomputing them, so
+    # one emitted token costs O(L) instead of the O(T²) training kernel.
+
+    def _serve_guard(self):
+        if self.impl not in ("full", "flash"):
+            raise ValueError(
+                f"serve decode supports impl='full'/'flash' attention "
+                f"configs, not {self.impl!r} (ring/ulysses shard the "
+                f"sequence axis, which a per-slot cache does not)"
+            )
+        if self.seq_sharded:
+            raise ValueError("serve decode requires seq_sharded=False")
+
+    def _project(self, params, x, n_local_heads=None, n_local_kv=None):
+        """(q, k, v) head tensors for x [B, T, d]. Local head counts are
+        overridable so the TP decode step can run the same code on a
+        head-sharded parameter shard."""
+        q = self._heads(
+            x @ params["q"]["kernel"] + params["q"]["bias"],
+            n_local_heads or self.num_heads,
+        )
+        k, v = (
+            self._heads(
+                x @ params[n]["kernel"] + params[n]["bias"],
+                n_local_kv or self._kv_heads,
+            )
+            for n in ("k", "v")
+        )
+        return q, k, v
+
+    def _gqa_repeat(self, k, v, n_heads):
+        group = n_heads // k.shape[2]
+        if group > 1:
+            k, v = (jnp.repeat(a, group, axis=2) for a in (k, v))
+        return k, v
+
+    def apply_decode(self, params, cache, x, pos):
+        """One decode step: x [B, 1, d] (the current token's features),
+        ``pos`` [B] its per-slot position. Writes this token's K/V into
+        the cache at ``pos``, attends q over the cached prefix, returns
+        (out [B, 1, d], updated cache)."""
+        from tpudml.serve.cache import read_all, write_token
+
+        self._serve_guard()
+        b = x.shape[0]
+        q, k_new, v_new = self._project(params, x)
+        if self.rope:
+            q = rotary_embedding(q, pos[:, None], self.rope_base)
+            k_new = rotary_embedding(k_new, pos[:, None], self.rope_base)
+        cache = write_token(cache, k_new, v_new, pos)
+        k, v = read_all(cache, x.dtype)
+        k, v = self._gqa_repeat(k, v, self.num_heads)
+        o = decode_attention(q, k, v, pos).reshape(b, 1, self.embed_dim)
+        return o @ params["out"]["kernel"] + params["out"]["bias"], cache
+
+    def apply_prefill(self, params, cache, x, slot, start: int):
+        """Prefill one chunk of one slot: x [1, C, d] are features of
+        prompt tokens at global positions [start, start+C). Writes their
+        K/V, attends the chunk over the slot's [0, start+C) window with
+        the globally-offset causal mask, returns (out [1, C, d], updated
+        cache). ``start`` is STATIC — one compiled program per chunk
+        index, shared across slots/requests. On TPU the window attention
+        reuses the flash kernel (``k_shift`` moves the causal diagonal
+        to the chunk's global offset)."""
+        from tpudml.serve.cache import read_slot_prefix, write_chunk
+
+        self._serve_guard()
+        c = x.shape[1]
+        q, k_new, v_new = self._project(params, x)
+        if self.rope:
+            positions = start + jnp.arange(c)
+            q = rotary_embedding(q, positions, self.rope_base)
+            k_new = rotary_embedding(k_new, positions, self.rope_base)
+        cache = write_chunk(cache, k_new, v_new, slot, start)
+        k, v = read_slot_prefix(cache, slot, start + c, x.dtype)
+        k, v = self._gqa_repeat(k, v, self.num_heads)
+        if jax.default_backend() == "tpu":
+            o = _chunk_flash_window(q, k, v, start)
+        else:
+            o = dot_product_attention(q, k, v, causal=True, q_offset=start)
+        o = o.reshape(1, c, self.embed_dim)
+        return o @ params["out"]["kernel"] + params["out"]["bias"], cache

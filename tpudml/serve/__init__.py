@@ -1,0 +1,34 @@
+"""tpudml.serve — prefill–decode LM serving with continuous batching.
+
+Layers: ``cache`` (preallocated per-layer KV caches, f32/bf16/int8),
+``engine`` (ONE jitted decode step + chunked prefill + slot scheduler),
+``load`` (seeded Poisson request streams), ``tp`` (the same steps under
+shard_map on a tensor-parallel mesh). See docs/API.md §Serving.
+"""
+
+from tpudml.serve.cache import KVCache, cache_bytes, init_cache
+from tpudml.serve.engine import (
+    SERVE_DECODE_MARKER,
+    RequestStats,
+    ServeConfig,
+    ServeReport,
+    ServingEngine,
+    make_cacheless_decode_step,
+    make_decode_step,
+)
+from tpudml.serve.load import Request, poisson_workload
+
+__all__ = [
+    "KVCache",
+    "Request",
+    "RequestStats",
+    "SERVE_DECODE_MARKER",
+    "ServeConfig",
+    "ServeReport",
+    "ServingEngine",
+    "cache_bytes",
+    "init_cache",
+    "make_cacheless_decode_step",
+    "make_decode_step",
+    "poisson_workload",
+]

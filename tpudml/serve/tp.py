@@ -1,0 +1,236 @@
+"""Tensor-parallel serving: the decode/prefill steps under shard_map.
+
+Parameter placement is EXACTLY ``tensor_parallel_rules`` — the serving
+graph reuses the training-time TP layout (column-parallel QKV/fc1/head,
+row-parallel out/fc2, vocab-sharded embedding), so a TP training
+checkpoint serves without resharding. The KV cache shards over its
+``kv_heads`` axis with the same placement as the K/V projections that
+fill it (``P(None, None, axis, None)``), so cache writes and attention
+reads are collective-free; the decode step pays the training stack's two
+psums per block (attention-out, fc2) plus one tiled all-gather of the
+[B, V/world] logits shards for the greedy argmax.
+
+Unlike GSPMD training (sharding constraints, partitioner inserts the
+collectives), serving uses MANUAL shard_map bodies: the decode hot loop
+is latency-bound at batch≈slots, and hand-placed collectives keep the
+per-step program free of partitioner-inferred resharding. The manual
+body reuses ``MultiHeadAttention._project`` with LOCAL head counts —
+head-aligned kernel shards make "run the same math on 1/world of the
+heads" literally the same code.
+
+Divisibility is REJECTED, not demoted: ``apply_rules`` silently
+replicates a non-dividing leaf, which GSPMD tolerates but a manual body
+(whose matmul shapes assume local shards) cannot.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpudml.parallel.mp import apply_rules, tensor_parallel_rules
+from tpudml.parallel.sharding import shard_map_fn
+from tpudml.serve.cache import KVCache, read_all, read_slot_prefix, write_chunk, write_token
+
+
+class TPServing:
+    """Sharded decode + prefill programs for one (model, mesh, axis)."""
+
+    def __init__(self, model, mesh, axis_name: str, cfg):
+        self.model = model
+        self.mesh = mesh
+        self.axis = axis_name
+        self.cfg = cfg
+        self.world = mesh.shape[axis_name]
+        d = model.embed_dim
+        kv_heads = model.num_kv_heads or model.num_heads
+        hidden = model._block().mlp_ratio * d
+        for what, n in (
+            ("num_heads", model.num_heads),
+            ("kv_heads", kv_heads),
+            ("vocab_size", model.vocab_size),
+            ("mlp hidden dim", hidden),
+        ):
+            if n % self.world:
+                raise ValueError(
+                    f"TP serving requires {what} ({n}) divisible by the "
+                    f"'{axis_name}' axis size ({self.world}); apply_rules "
+                    f"would demote the shard and break the manual decode body"
+                )
+        self.h_local = model.num_heads // self.world
+        self.kv_local = kv_heads // self.world
+        self.v_local = model.vocab_size // self.world
+        self.param_specs = None  # set by shard_params (needs the real tree)
+        self._prefill_cache: dict = {}
+        self.decode_step = None
+
+    # ------------------------------------------------------------ placement
+
+    def shard_params(self, params):
+        self.param_specs = apply_rules(
+            tensor_parallel_rules(self.axis), params, self.mesh
+        )
+        sharded = jax.device_put(
+            params,
+            jax.tree.map(lambda s: NamedSharding(self.mesh, s),
+                         self.param_specs, is_leaf=lambda x: isinstance(x, P)),
+        )
+        self.decode_step = self._build_decode()
+        return sharded
+
+    def _cache_spec_tree(self):
+        kind = self.cfg.cache_kind
+        kv = P(None, None, self.axis, None)
+        sc = P(None, None, self.axis) if kind == "int8" else P()
+        return tuple(
+            KVCache(k=kv, v=kv, k_scale=sc, v_scale=sc, kind=kind)
+            for _ in range(self.model.num_layers)
+        )
+
+    def init_caches(self):
+        caches = self.model.init_decode_cache(
+            self.cfg.slots, self.cfg.max_len, self.cfg.cache_kind
+        )
+        shardings = jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s), self._cache_spec_tree(),
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        return jax.device_put(caches, shardings)
+
+    # ------------------------------------------------------------ shared math
+
+    def _embed(self, params, tokens):
+        """Vocab-sharded embedding gather: mask tokens outside this
+        shard's row range, gather locally, psum the one nonzero
+        contribution. [B] → [B, 1, d]."""
+        table = params["tok_embed"]  # [V/world, d]
+        idx = lax.axis_index(self.axis)
+        local = tokens - idx * self.v_local
+        ok = (local >= 0) & (local < self.v_local)
+        rows = table[jnp.clip(local, 0, self.v_local - 1)]
+        rows = rows * ok[:, None].astype(rows.dtype)
+        return lax.psum(rows, self.axis)[:, None, :]
+
+    def _block_parts(self):
+        return self.model._block()._parts()
+
+    def _tp_block(self, parts, p, h, attend):
+        """One pre-LN block on local shards: column-parallel in,
+        psum-then-bias on the row-parallel way out."""
+        attn = parts["attn"]
+        y = parts["ln1"](p["ln1"], h)
+        a, cache = attend(attn, p["attn"], y)
+        o = lax.psum(a @ p["attn"]["out"]["kernel"], self.axis)
+        h = h + o + p["attn"]["out"]["bias"]
+        y2 = parts["ln2"](p["ln2"], h)
+        f = jax.nn.gelu(y2 @ p["fc1"]["kernel"] + p["fc1"]["bias"])
+        h = h + lax.psum(f @ p["fc2"]["kernel"], self.axis) + p["fc2"]["bias"]
+        return h, cache
+
+    # --------------------------------------------------------------- decode
+
+    def _build_decode(self):
+        model, cfg, axis = self.model, self.cfg, self.axis
+        from tpudml.nn.attention import decode_attention, rotary_embedding
+
+        def _serve_decode_step(params, caches, tokens, pos):
+            params = model._cast_params(params)
+            parts = self._block_parts()
+            h = self._embed(params, tokens)
+            if not model.rope:
+                h = h + params["pos_embed"][pos][:, None, :]
+            new_caches = []
+            for i, cache in enumerate(caches):
+                def attend(attn, p, y, cache=cache):
+                    q, k_new, v_new = attn._project(
+                        p, y, self.h_local, self.kv_local
+                    )
+                    if model.rope:
+                        q = rotary_embedding(q, pos[:, None], model.rope_base)
+                        k_new = rotary_embedding(
+                            k_new, pos[:, None], model.rope_base
+                        )
+                    cache = write_token(cache, k_new, v_new, pos)
+                    k, v = read_all(cache, y.dtype)
+                    k, v = attn._gqa_repeat(k, v, self.h_local)
+                    o = decode_attention(q, k, v, pos)
+                    b = y.shape[0]
+                    return o.reshape(b, 1, -1), cache
+
+                h, cache = self._tp_block(parts, params[f"block{i}"], h, attend)
+                new_caches.append(cache)
+            # Head module on LOCAL shards: ln_f params are replicated and
+            # the vocab projection is column-parallel, so the stock module
+            # emits this shard's [B, 1, V/world] logits slice directly.
+            ll = model._head()(
+                {k: params[k] for k in ("ln_f", "head")}, h
+            )
+            logits = lax.all_gather(ll[:, 0, :], axis, axis=-1, tiled=True)
+            return (jnp.argmax(logits, axis=-1).astype(jnp.int32), logits,
+                    tuple(new_caches))
+
+        inner = jax.jit(_serve_decode_step)
+
+        def body(params, caches, tokens, pos):
+            return inner(params, caches, tokens, pos)
+
+        sm = shard_map_fn(
+            body, self.mesh,
+            in_specs=(self.param_specs, self._cache_spec_tree(), P(), P()),
+            out_specs=(P(), P(), self._cache_spec_tree()),
+        )
+        return jax.jit(sm, donate_argnums=(1,))
+
+    # -------------------------------------------------------------- prefill
+
+    def prefill_at(self, start: int):
+        model, axis = self.model, self.axis
+        c = self.cfg.prefill_chunk
+        from tpudml.nn.attention import (
+            _chunk_flash_window, dot_product_attention, rotary_embedding,
+        )
+        if not model.rope and start + c > model.max_len:
+            raise ValueError(
+                f"prefill window {start + c} exceeds max_len {model.max_len}"
+            )
+
+        def _serve_prefill_chunk(params, caches, chunk, slot):
+            params = model._cast_params(params)
+            parts = self._block_parts()
+            h = self._embed(params, chunk[0])  # [C, 1, d] — re-lay below
+            h = h[:, 0, :][None]  # [1, C, d]
+            if not model.rope:
+                h = h + params["pos_embed"][start:start + c][None]
+            new_caches = []
+            for i, cache in enumerate(caches):
+                def attend(attn, p, y, cache=cache):
+                    q, k_new, v_new = attn._project(
+                        p, y, self.h_local, self.kv_local
+                    )
+                    if model.rope:
+                        positions = start + jnp.arange(c)
+                        q = rotary_embedding(q, positions, model.rope_base)
+                        k_new = rotary_embedding(k_new, positions, model.rope_base)
+                    cache = write_chunk(cache, k_new, v_new, slot, start)
+                    k, v = read_slot_prefix(cache, slot, start + c, y.dtype)
+                    k, v = attn._gqa_repeat(k, v, self.h_local)
+                    if jax.default_backend() == "tpu":
+                        o = _chunk_flash_window(q, k, v, start)
+                    else:
+                        o = dot_product_attention(
+                            q, k, v, causal=True, q_offset=start
+                        )
+                    return o.reshape(1, c, -1), cache
+
+                h, cache = self._tp_block(parts, params[f"block{i}"], h, attend)
+                new_caches.append(cache)
+            return tuple(new_caches)
+
+        sm = shard_map_fn(
+            _serve_prefill_chunk, self.mesh,
+            in_specs=(self.param_specs, self._cache_spec_tree(), P(), P()),
+            out_specs=self._cache_spec_tree(),
+        )
+        return jax.jit(sm, donate_argnums=(1,))
