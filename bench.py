@@ -714,6 +714,77 @@ def bench_serve(on_tpu) -> dict:
     }
 
 
+def bench_sentinel(on_tpu) -> dict:
+    """``--sentinel`` report: the flagship-LM train step timed with and
+    without the in-graph step sentinel (``resilience.GradSentinel``)
+    wrapping the optimizer — the sentinel tax. Same model config and
+    fori timing protocol as the secondary LM row, so the two step times
+    differ by exactly the sentinel's finiteness reduction + counter
+    selects. Acceptance (BASELINE.md round 9): ``overhead_frac`` ≤ 0.03.
+    """
+    from tpudml.core.prng import seed_key
+    from tpudml.data.datasets import synthetic_lm
+    from tpudml.models import TransformerLM
+    from tpudml.optim import make_optimizer
+    from tpudml.resilience import attach_sentinel
+    from tpudml.train import TrainState, make_lm_fused_train_step_body
+
+    if on_tpu:
+        cfg = dict(vocab_size=32768, embed_dim=512, num_heads=4, num_layers=6)
+        seq_len, batch = 1024, 8
+    else:  # CPU dryrun: same shape as the dev-smoke LM row
+        cfg = dict(vocab_size=256, embed_dim=64, num_heads=4, num_layers=2)
+        seq_len, batch = 128, 4
+    model = TransformerLM(
+        **cfg, max_len=seq_len, impl="flash" if on_tpu else "full",
+        rope=True, compute_dtype=jnp.bfloat16 if on_tpu else None,
+        fused_ln=on_tpu,
+    )
+    seqs = jnp.asarray(synthetic_lm(batch, seq_len, cfg["vocab_size"], seed=1))
+    x, y = seqs[:, :-1], seqs[:, 1:]
+    tokens = batch * seq_len
+
+    def timed(opt) -> float:
+        fused_body = make_lm_fused_train_step_body(
+            model, opt, save_scores=on_tpu
+        )
+
+        def body(ts, tokens_in, labels):
+            new_ts, metrics = fused_body(ts, tokens_in, labels)
+            return new_ts, metrics["loss"]
+
+        ts0 = TrainState.create(model, opt, seed_key(0))
+        # reps=3 on CPU too: the A/B divides two step times, and a
+        # single-rep reading on the 1-core box jitters by ±20% — far
+        # above the ≤3% tax this row exists to measure.
+        sec, _ = _time_fori(
+            body, ts0, (x, y),
+            *((8, 40) if on_tpu else (1, 3)), reps=3,
+        )
+        return sec
+
+    sec_plain = timed(make_optimizer("adamw", 3e-4))
+    sec_sent = timed(attach_sentinel(make_optimizer("adamw", 3e-4)))
+    return {
+        "metric": "sentinel_overhead_lm_step_fori",
+        "config": {**cfg, "seq_len": seq_len, "batch": batch,
+                   "platform": "tpu" if on_tpu else "cpu_dryrun"},
+        "step_ms_plain": round(sec_plain * 1e3, 3),
+        "step_ms_sentinel": round(sec_sent * 1e3, 3),
+        "tokens_per_sec_plain": round(tokens / sec_plain, 1),
+        "tokens_per_sec_sentinel": round(tokens / sec_sent, 1),
+        "value": round(sec_sent / sec_plain - 1.0, 4),
+        "unit": "overhead_fraction",
+    }
+
+
+def main_sentinel() -> None:
+    """Driver for ``python bench.py --sentinel``: prints ONE JSON line,
+    same contract as ``main()``, for the sentinel on/off A/B."""
+    on_tpu = jax.devices()[0].platform != "cpu"
+    print(json.dumps(bench_sentinel(on_tpu)))
+
+
 def main_serve() -> None:
     """Driver for ``python bench.py --serve``: prints ONE JSON line, same
     contract as ``main()``, for the serving comparison."""
@@ -803,5 +874,7 @@ if __name__ == "__main__":
         main_moe()
     elif "--serve" in sys.argv[1:]:
         main_serve()
+    elif "--sentinel" in sys.argv[1:]:
+        main_sentinel()
     else:
         main()

@@ -38,20 +38,22 @@ def init_distributed(cfg: TrainConfig) -> None:
 def setup_checkpointing(cfg: TrainConfig, ts):
     """(train_state, hooks, manager) per the config's checkpoint fields.
 
-    With ``--ckpt_dir`` set: ``--resume`` restores the latest checkpoint
-    into ``ts`` (every host reads the same files — the persistent form of
-    the reference's rank-0 parameter broadcast, SURVEY.md §5.4), and
-    ``--ckpt_every N`` installs a rolling-save train_loop hook. The caller
-    does the final save via the returned manager.
+    With ``--ckpt_dir`` set: ``--resume`` restores the LATEST VALID
+    checkpoint into ``ts`` — restores verify per-leaf checksums and walk
+    past corrupt/partial ``step_*`` dirs (every host reads the same files
+    — the persistent form of the reference's rank-0 parameter broadcast,
+    SURVEY.md §5.4; integrity semantics in docs/RESILIENCE.md) — and
+    ``--ckpt_every N`` installs a rolling-save train_loop hook. The
+    caller does the final save via the returned manager.
     """
     if not cfg.ckpt_dir:
         return ts, [], None
-    from tpudml.checkpoint import CheckpointManager, checkpoint_hook
+    from tpudml.checkpoint import CheckpointHook, CheckpointManager
 
     mgr = CheckpointManager(cfg.ckpt_dir)
     if cfg.resume:
         ts = mgr.restore_latest(ts)
-    hooks = [checkpoint_hook(mgr, cfg.ckpt_every)] if cfg.ckpt_every else []
+    hooks = [CheckpointHook(mgr, every_n_steps=cfg.ckpt_every)] if cfg.ckpt_every else []
     return ts, hooks, mgr
 
 

@@ -18,7 +18,7 @@ single-host multi-device (default), simulated devices
 (jax.distributed).
 
 Run: ``python -m tasks.task2 [--aggregation allgather] [--measure_comm]
-[--zero1] [--bottleneck_rank 1] [--n_devices 2]``
+[--zero1] [--sentinel] [--bottleneck_rank 1] [--n_devices 2]``
 """
 
 from __future__ import annotations
@@ -85,6 +85,7 @@ def run(cfg: TrainConfig) -> dict:
         mesh,
         aggregation=cfg.aggregation,
         zero1=cfg.zero1,
+        sentinel=cfg.sentinel,
         measure_comm=cfg.measure_comm or cfg.bottleneck_rank is not None,
         bottleneck_rank=cfg.bottleneck_rank,
         bottleneck_delay_s=cfg.bottleneck_delay_s,
@@ -93,6 +94,12 @@ def run(cfg: TrainConfig) -> dict:
     )
     ts = dp.create_state(seed_key(cfg.seed))
     ts, hooks, ckpt_mgr = setup_checkpointing(cfg, ts)
+    if dp.sentinel is not None:
+        # Escalate past the consecutive-skip budget with a diagnostic
+        # naming the poisoned leaf/microbatch (docs/RESILIENCE.md).
+        from tpudml.resilience import sentinel_hook
+
+        hooks.append(sentinel_hook(dp.sentinel, ts.params))
     step = dp.make_train_step()
 
     writer = MetricsWriter(cfg.log_dir, run_name=f"task2-{cfg.aggregation}-w{world}")

@@ -95,13 +95,20 @@ def test_bottleneck_injection_slows_steps(mesh, batch):
     opt = make_optimizer("sgd", 0.01)
     delay = 0.05
 
+    def best_of(step, ts, reps=3):
+        # min-of-reps: one scheduler hiccup must not decide the test.
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            step(ts, *batch)
+            times.append(time.perf_counter() - t0)
+        return min(times)
+
     base = DataParallel(model, opt, mesh, measure_comm=True)
     ts = base.create_state(seed_key(0))
     step = base.make_train_step()
     step(ts, *batch)  # compile
-    t0 = time.perf_counter()
-    step(ts, *batch)
-    base_time = time.perf_counter() - t0
+    base_time = best_of(step, ts)
 
     slow = DataParallel(
         model, opt, mesh, measure_comm=True,
@@ -110,9 +117,7 @@ def test_bottleneck_injection_slows_steps(mesh, batch):
     ts2 = slow.create_state(seed_key(0))
     step2 = slow.make_train_step()
     step2(ts2, *batch)
-    t0 = time.perf_counter()
-    step2(ts2, *batch)
-    slow_time = time.perf_counter() - t0
+    slow_time = best_of(step2, ts2)
 
     assert slow_time >= base_time + 0.8 * delay
 

@@ -329,3 +329,68 @@ def test_tpu_vm_cli_dry_run(capsys):
     assert "create pod1" in out and "delete pod1" in out
     assert "echo hi" in out
 
+
+
+def test_restart_backoff_is_seeded_and_budgeted(tmp_path):
+    """Backoff delays are a pure function of the spec's seed (exponential
+    base x factor^(attempt-1) + seeded jitter), recorded on the result,
+    and announced in the relaunch message."""
+    import random
+
+    spec = ClusterSpec(
+        num_processes=1, max_restarts=2, grace_s=1.0,
+        restart_backoff_s=0.05, restart_backoff_factor=2.0,
+        restart_backoff_jitter=0.5, restart_backoff_seed=42,
+    )
+    sink = io.StringIO()
+    result = launch([PY, "-c", "import sys; sys.exit(7)"], spec, sink=sink)
+    assert not result.success
+    assert result.attempts == 3
+
+    rng = random.Random(42)
+    expected = []
+    for attempt in (1, 2):
+        d = 0.05 * 2.0 ** (attempt - 1)
+        expected.append(d + rng.uniform(0, 0.5 * d))
+    assert result.backoffs_s == pytest.approx(expected)
+    out = sink.getvalue()
+    assert f"after {expected[0]:.2f}s backoff" in out
+    assert "restart 1/2" in out and "restart 2/2" in out
+    # The spec (with its backoff knobs) still round-trips through JSON.
+    path = tmp_path / "spec.json"
+    spec.to_json(path)
+    assert ClusterSpec.from_json(path) == spec
+
+
+def test_backoff_defaults_keep_immediate_restart():
+    """restart_backoff_s=0 (the default) restarts immediately and emits
+    no backoff chatter — existing restart flows are unchanged."""
+    sink = io.StringIO()
+    spec = ClusterSpec(num_processes=1, max_restarts=1, grace_s=1.0)
+    result = launch([PY, "-c", "import sys; sys.exit(3)"], spec, sink=sink)
+    assert result.backoffs_s == [0.0]
+    assert "backoff" not in sink.getvalue()
+
+
+def test_rank_kill_containment_and_backoff_recovery(tmp_path):
+    """The resilience drill at the process level: faults.rank_kill_hook
+    hard-kills rank 1 once (os._exit, no cleanup), the launcher tears
+    the job down, waits out the seeded backoff, relaunches, and the
+    marker file makes the restarted attempt run clean."""
+    marker = str(tmp_path / "killed-once")
+    sink = io.StringIO()
+    spec = ClusterSpec(
+        num_processes=2, max_restarts=1, grace_s=2.0,
+        restart_backoff_s=0.01,
+    )
+    code = (
+        "from tpudml.resilience import rank_kill_hook;"
+        f"h = rank_kill_hook(3, marker={marker!r}, rank=1);"
+        "[h(step=s) for s in range(5)]"
+    )
+    result = launch([PY, "-c", code], spec, sink=sink)
+    assert result.success, sink.getvalue()
+    assert result.attempts == 2
+    assert result.backoffs_s == [0.01]
+    assert "restart 1/1" in sink.getvalue()
+    assert (tmp_path / "killed-once").exists()

@@ -1,0 +1,299 @@
+"""Step-sentinel tests (docs/RESILIENCE.md): the in-graph guard must
+skip anomalous updates bit-exactly, compose with the DP engines
+(plain / ZeRO-1 / FSDP), name the poisoned leaf and microbatch in its
+escalation, and cost nothing when the fault never fires.
+
+The injected faults come from tpudml.resilience.faults — seeded and
+deterministic, so every assertion here is exact, not statistical.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpudml.core.config import MeshConfig
+from tpudml.core.dist import make_mesh
+from tpudml.core.prng import seed_key
+from tpudml.models import ForwardMLP, LeNet
+from tpudml.optim import make_optimizer
+from tpudml.optim.zero1 import ZeRO1
+from tpudml.parallel.dp import DataParallel
+from tpudml.parallel.fsdp import FSDP
+from tpudml.resilience import (
+    GradSentinel,
+    SentinelTripped,
+    attach_sentinel,
+    corrupt_microbatch,
+    find_sentinel,
+    find_sentinel_state,
+    param_leaf_names,
+    sentinel_hook,
+    sentinel_stats,
+)
+
+WORLD = 2
+GLOBAL = 16
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(MeshConfig({"data": WORLD}), jax.devices()[:WORLD])
+
+
+@pytest.fixture(scope="module")
+def batch():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(GLOBAL, 28, 28, 1)).astype(np.float32)
+    y = rng.integers(0, 10, size=(GLOBAL,)).astype(np.int32)
+    return x, y
+
+
+def leaves_equal(a, b):
+    fa, fb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(fa) == len(fb)
+    return all(
+        np.array_equal(np.asarray(u), np.asarray(v), equal_nan=True)
+        for u, v in zip(fa, fb)
+    )
+
+
+def snapshot(tree):
+    return jax.tree.map(lambda a: np.asarray(a), tree)
+
+
+# ----------------------------------------------------- engine composition
+
+
+@pytest.mark.parametrize("zero1", [False, True], ids=["plain", "zero1"])
+def test_nan_step_skipped_bit_exact(mesh, batch, zero1):
+    """The acceptance-criterion parity: a poisoned step increments the
+    skip counter, leaves params AND base optimizer state bit-identical,
+    and the post-recovery trajectory matches a run where the poisoned
+    batch never arrived."""
+    x, y = batch
+    xbad = corrupt_microbatch(x, "nan", seed=1)
+
+    dp = DataParallel(LeNet(), make_optimizer("adam", 1e-3), mesh,
+                      zero1=zero1, sentinel=True)
+    step = dp.make_train_step()
+
+    # Chain A, never sees the poison (separate chain: the step donates
+    # its TrainState, so a shared prefix cannot be forked).
+    ts_a = dp.create_state(seed_key(0))
+    ts_a, _ = step(ts_a, x, y)
+    ts_a, _ = step(ts_a, x, y)
+
+    # Chain B: clean, poisoned (skipped), clean.
+    ts_b = dp.create_state(seed_key(0))
+    ts_b, _ = step(ts_b, x, y)  # clean step: Adam moments non-trivial
+    p_before = snapshot(ts_b.params)
+
+    ts_b, m2 = step(ts_b, xbad, y)
+    st = sentinel_stats(ts_b.opt_state)
+    assert st["skips"] == 1 and st["consecutive"] == 1
+    assert st["bad_leaf"] >= 0
+    assert int(m2["bad_micro"]) == 0  # single microbatch, tainted
+    assert leaves_equal(ts_b.params, p_before), "params changed on a skipped step"
+
+    # Recovery: counter resets, and the continued trajectory is bit-exact
+    # with the chain that never saw the poisoned batch (rng-free step, so
+    # the only state is params + opt state — both carried forward exactly).
+    ts_b, _ = step(ts_b, x, y)
+    st3 = sentinel_stats(ts_b.opt_state)
+    assert st3["consecutive"] == 0 and st3["skips"] == 1
+    assert leaves_equal(ts_b.params, ts_a.params)
+    assert leaves_equal(
+        find_sentinel_state(ts_b.opt_state)["base"],
+        find_sentinel_state(ts_a.opt_state)["base"],
+    )
+
+
+def test_inf_skip_under_fsdp(mesh):
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(8, 784)).astype(np.float32)
+    y = rng.integers(0, 10, size=(8,)).astype(np.int32)
+    xbad = corrupt_microbatch(x, "inf", seed=2)
+
+    eng = FSDP(ForwardMLP(), make_optimizer("adam", 1e-3), mesh, sentinel=True)
+    ts = eng.create_state(seed_key(0))
+    step = eng.make_train_step()
+    ts, _ = step(ts, x, y)
+    p_before = snapshot(ts.params)
+    ts2, _ = step(ts, xbad, y)
+    st = sentinel_stats(ts2.opt_state)
+    assert st["skips"] == 1
+    assert leaves_equal(ts2.params, p_before)
+
+
+def test_accum_taint_names_poisoned_microbatch(mesh, batch):
+    """Under gradient accumulation the taint tracker reports the FIRST
+    poisoned microbatch index, not just "something was NaN"."""
+    x, y = batch
+    accum = 2
+    # Replica 0 holds global rows [0:8]; its microbatch 1 is rows [4:8].
+    xbad = x.copy()
+    xbad[5, 3, 3, 0] = np.nan
+
+    dp = DataParallel(LeNet(), make_optimizer("sgd", 0.01), mesh,
+                      accum_steps=accum, sentinel=True)
+    ts = dp.create_state(seed_key(0))
+    step = dp.make_train_step()
+    ts, m = step(ts, x, y)
+    assert int(m["bad_micro"]) == -1  # clean
+    ts2, m2 = step(ts, xbad, y)
+    assert int(m2["bad_micro"]) == 1
+    assert sentinel_stats(ts2.opt_state)["skips"] == 1
+
+
+def test_hook_escalates_past_budget(mesh, batch):
+    """sentinel_hook raises SentinelTripped once the CONSECUTIVE skip
+    count exceeds the budget, naming the first non-finite leaf and the
+    poisoned microbatch — and stays quiet within budget."""
+    x, y = batch
+    xbad = corrupt_microbatch(x, "nan", seed=4)
+
+    dp = DataParallel(LeNet(), make_optimizer("adam", 1e-3), mesh,
+                      sentinel={"skip_budget": 1})
+    assert dp.sentinel is not None and dp.sentinel.skip_budget == 1
+    ts = dp.create_state(seed_key(0))
+    step = dp.make_train_step()
+    hook = sentinel_hook(dp.sentinel, ts.params)
+
+    ts, m = step(ts, xbad, y)  # consecutive = 1 == budget: tolerated
+    hook(step=1, train_state=ts, metrics=m)
+    ts, m = step(ts, xbad, y)  # consecutive = 2 > budget: escalate
+    with pytest.raises(SentinelTripped, match="2 consecutive") as exc:
+        hook(step=2, train_state=ts, metrics=m)
+    names = param_leaf_names(ts.params)
+    st = sentinel_stats(ts.opt_state)
+    assert names[st["bad_leaf"]] in str(exc.value)
+    assert "microbatch 0" in str(exc.value)
+
+
+def test_hook_noop_without_sentinel(mesh, batch):
+    """On a plain engine the hook finds no sentinel state and must not
+    crash (same hook list can be installed unconditionally)."""
+    x, y = batch
+    dp = DataParallel(LeNet(), make_optimizer("adam", 1e-3), mesh)
+    ts = dp.create_state(seed_key(0))
+    sent = GradSentinel(make_optimizer("adam", 1e-3), skip_budget=1)
+    sentinel_hook(sent)(step=1, train_state=ts, metrics={})
+
+
+# ------------------------------------------------- optimizer-level guard
+
+
+def _sgd_sentinel(**kw):
+    return GradSentinel(make_optimizer("sgd", 0.1), **kw)
+
+
+def test_spike_guard_arms_after_warmup():
+    """The norm-spike test must stay DISARMED through warmup (early
+    training norms are noisy) and then skip a step whose norm exceeds
+    spike_factor x the running EMA."""
+    sent = _sgd_sentinel(spike_factor=5.0, warmup_steps=2, ema_decay=0.5)
+    params = {"w": jnp.ones(4)}
+    state = sent.init(params)
+    small = {"w": jnp.full(4, 0.1)}
+    huge = {"w": jnp.full(4, 100.0)}
+
+    # A spike during warmup passes (finite, guard not armed yet).
+    p, s = sent.update(huge, state, params)
+    assert not np.array_equal(np.asarray(p["w"]), np.asarray(params["w"]))
+    assert int(s["skips"]) == 0
+
+    for _ in range(2):  # arm: two good steps at small norm
+        params, state = sent.update(small, state, params)
+    assert int(state["good_steps"]) == 2
+    ema_before = float(state["norm_ema"])
+
+    p2, s2 = sent.update(huge, state, params)
+    assert int(s2["skips"]) == 1 and int(s2["consecutive"]) == 1
+    assert np.array_equal(np.asarray(p2["w"]), np.asarray(params["w"]))
+    # A skipped step must not pollute the EMA the guard compares against.
+    assert float(s2["norm_ema"]) == ema_before
+    # bad_leaf stays -1: the spike was finite, no leaf to blame.
+    assert int(s2["bad_leaf"]) == -1
+
+
+def test_outlier_passes_without_spike_guard():
+    """A finite outlier gradient is NOT caught by the finiteness test
+    alone — that is exactly what spike_factor exists for."""
+    sent = _sgd_sentinel()  # spike_factor=0: finiteness only
+    params = {"w": jnp.ones(4)}
+    state = sent.init(params)
+    outlier = {"w": jnp.full(4, 1e30)}
+    _, s = sent.update(outlier, state, params)
+    assert int(s["skips"]) == 0
+
+
+def test_state_leaves_are_distinct_buffers():
+    """Engines donate the TrainState; XLA rejects one buffer appearing at
+    two donated positions, so every sentinel counter must be its own
+    array (regression: a shared zeros() scalar deadlocked the DP step)."""
+    sent = _sgd_sentinel()
+    state = sent.init({"w": jnp.ones(2)})
+    scalars = [state[k] for k in ("skips", "consecutive", "good_steps")]
+    assert len({id(x) for x in scalars}) == len(scalars)
+
+
+def test_constructor_validation():
+    with pytest.raises(ValueError, match="base optimizer"):
+        GradSentinel()
+    with pytest.raises(ValueError, match="skip_budget"):
+        _sgd_sentinel(skip_budget=0)
+    with pytest.raises(ValueError, match="spike_factor"):
+        _sgd_sentinel(spike_factor=0.5)
+
+
+# ------------------------------------------------------------- placement
+
+
+def test_attach_sentinel_goes_inside_zero1():
+    """attach_sentinel must guard the post-reduce-scatter chunk grads:
+    the ZeRO-1 wrapper stays outermost and the data axis is appended to
+    the sentinel's psum axes (chunks are disjoint over it)."""
+    base = make_optimizer("adam", 1e-3)
+    z = ZeRO1(base, axis_name="data", world=WORLD)
+    out = attach_sentinel(z, ())
+    assert isinstance(out, ZeRO1)
+    assert isinstance(out.base, GradSentinel)
+    assert out.base.axis_names == ("data",)
+    assert find_sentinel(out) is out.base
+
+    plain = attach_sentinel(base, ())
+    assert isinstance(plain, GradSentinel)
+    assert plain.axis_names == ()
+    assert find_sentinel(plain) is plain
+
+
+def test_find_sentinel_state_in_nested_tree():
+    sent = _sgd_sentinel()
+    st = sent.init({"w": jnp.ones(2)})
+    nested = {"outer": (st, {"noise": 1})}
+    assert find_sentinel_state(nested) is st
+    assert find_sentinel_state({"a": [1, 2]}) is None
+    with pytest.raises(ValueError, match="no GradSentinel"):
+        sentinel_stats({"a": 1})
+
+
+# ---------------------------------------------------- fault determinism
+
+
+def test_corrupt_microbatch_is_seeded_and_scoped():
+    x = np.random.default_rng(0).normal(size=(8, 4)).astype(np.float32)
+    a = corrupt_microbatch(x, "nan", micro=1, accum_steps=4, seed=9)
+    b = corrupt_microbatch(x, "nan", micro=1, accum_steps=4, seed=9)
+    np.testing.assert_array_equal(a, b)  # same seed, same poison
+    c = corrupt_microbatch(x, "nan", micro=1, accum_steps=4, seed=10)
+    assert not np.array_equal(a, c, equal_nan=True)
+    # Only microbatch 1 (rows 2:4) is touched; the original is untouched.
+    assert np.isfinite(x).all()
+    assert np.isnan(a[2:4]).any()
+    np.testing.assert_array_equal(a[:2], x[:2])
+    np.testing.assert_array_equal(a[4:], x[4:])
+    with pytest.raises(ValueError, match="unknown corruption"):
+        corrupt_microbatch(x, "gamma_ray")
+    with pytest.raises(ValueError, match="out of range"):
+        corrupt_microbatch(x, "nan", micro=4, accum_steps=4)

@@ -166,3 +166,103 @@ def test_workload_generator_contract():
         assert 1 <= len(r.prompt) <= 4
         assert 2 <= r.max_new_tokens <= 5
         assert ledger[r.rid]["prompt_len"] == len(r.prompt)
+
+
+# ------------------------------------------------------- overload guard
+
+
+def _req(rid, plen, owed, t=0.0):
+    return Request(rid=rid, prompt=(np.arange(plen, dtype=np.int32) % V),
+                   max_new_tokens=owed, arrival_time=t)
+
+
+def _terminal_states(rep):
+    """Every request must end in EXACTLY one terminal state."""
+    out = {}
+    for rid, s in rep.requests.items():
+        states = [name for name, v in
+                  (("finished", s.finished), ("rejected", s.rejected),
+                   ("expired", s.expired)) if v is not None]
+        assert len(states) == 1, (rid, states)
+        out[rid] = states[0]
+    return out
+
+
+def test_overload_bounded_queue_no_starvation(setup):
+    """2x-overload soak: the waiting line never exceeds max_queue (the
+    excess is rejected at admission control, not silently buffered), no
+    admitted request starves (FIFO order preserved), and every rid lands
+    in exactly one terminal state with its full owed tokens if it
+    finished."""
+    model, params = setup
+    cfg = ServeConfig(slots=1, max_len=64, prefill_chunk=8,
+                      max_queue=3, step_time_s=0.01)
+    eng = ServingEngine(model, params, cfg)
+    reqs, ledger = poisson_workload(
+        12, 40.0, seed=5, vocab_size=V, prompt_len=(2, 6),
+        new_tokens=(8, 8))  # service ~12.5 req/s vs 40 qps offered
+    rep = eng.run(reqs)
+
+    states = _terminal_states(rep)
+    assert rep.rejected > 0  # the guard actually engaged
+    assert rep.peak_queue_depth <= cfg.max_queue
+    admitted = [e[1] for e in rep.events if e[0] == "admit"]
+    assert admitted == sorted(admitted)  # FIFO: arrival order == admit order
+    for rid, state in states.items():
+        if state == "finished":
+            assert len(rep.requests[rid].tokens) == ledger[rid]["max_new_tokens"]
+        else:
+            assert state == "rejected"  # no deadline configured
+    # Reject events carry slot -1 (never admitted).
+    assert all(e[2] == -1 for e in rep.events if e[0] == "reject")
+
+
+def test_deadline_expires_queued_and_midflight(setup):
+    """One TTL, both expiry paths: the queued request dies waiting for
+    the only slot (slot -1 in the event), the admitted one dies at a
+    step boundary mid-generation (its slot id in the event) keeping its
+    partial tokens in the ledger."""
+    model, params = setup
+    cfg = ServeConfig(slots=1, max_len=64, prefill_chunk=8,
+                      deadline_s=0.2, step_time_s=0.01)
+    eng = ServingEngine(model, params, cfg)
+    rep = eng.run([_req(0, 4, 50), _req(1, 4, 4)])
+
+    states = _terminal_states(rep)
+    assert states == {0: "expired", 1: "expired"}
+    r0, r1 = rep.requests[0], rep.requests[1]
+    assert 0 < len(r0.tokens) < 50  # mid-flight: partial generation kept
+    assert r0.finished is None
+    assert len(r1.tokens) == 0  # starved in the queue, never admitted
+    kinds = {e[1]: e for e in rep.events if e[0] == "expire"}
+    assert kinds[0][2] == 0  # r0 expired IN its slot
+    assert kinds[1][2] == -1  # r1 expired in the queue
+
+
+def test_overload_run_is_deterministic(setup):
+    """Same seed, same config -> byte-identical event log and ledger
+    (the virtual step clock removes wall time from scheduling)."""
+    model, params = setup
+    cfg = ServeConfig(slots=2, max_len=64, prefill_chunk=8, max_queue=2,
+                      deadline_s=0.5, step_time_s=0.01)
+
+    def once():
+        reqs, _ = poisson_workload(10, 30.0, seed=7, vocab_size=V,
+                                   prompt_len=(2, 8), new_tokens=(4, 9))
+        return ServingEngine(model, params, cfg).run(reqs)
+
+    a, b = once(), once()
+    assert a.events == b.events
+    assert a.peak_queue_depth == b.peak_queue_depth
+    assert _terminal_states(a) == _terminal_states(b)
+    for rid in a.requests:
+        assert a.requests[rid].tokens == b.requests[rid].tokens
+
+
+def test_overload_config_validation():
+    with pytest.raises(ValueError, match="max_queue"):
+        ServeConfig(max_queue=0)
+    with pytest.raises(ValueError, match="deadline_s"):
+        ServeConfig(deadline_s=0.0)
+    with pytest.raises(ValueError, match="step_time_s"):
+        ServeConfig(step_time_s=-1.0)

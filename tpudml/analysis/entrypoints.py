@@ -18,6 +18,7 @@ entrypoint      engine / step builder                  task analogue
 task1_single    tpudml.train.make_train_step           task1
 task2_dp        parallel/dp.py DataParallel (fused)    task2, task3
 dp_zero1        DataParallel + ZeRO-1 sharded update   task2 --zero1
+dp_sentinel     dp_zero1 + in-graph step sentinel      task2 --sentinel
 task4_mp        parallel/mp.py GSPMDParallel           task4
 fsdp            parallel/fsdp.py FSDP                  task5 --mode fsdp
 tp_fused        GSPMDParallel + sharded fused head     task5 tp --fused_xent
@@ -132,6 +133,25 @@ def build_dp_zero1() -> list[Program]:
     step = dp.make_train_step()
     x, y = _lenet_batch()
     return [Program("dp_zero1", step.jitted, (ts, x, y))]
+
+
+def build_dp_sentinel() -> list[Program]:
+    """ZeRO-1 data parallelism with the in-graph step sentinel: the
+    traced step carries an ``is_finite`` gate between the gradients and
+    the update, so J111 stays silent here (and J108 stays silent via the
+    reduce-scatter) — the guarded counterpart of the plain engines the
+    rule fires on."""
+    from tpudml.core.prng import seed_key
+    from tpudml.models import LeNet
+    from tpudml.optim import make_optimizer
+    from tpudml.parallel.dp import DataParallel
+
+    dp = DataParallel(LeNet(), make_optimizer("adam", 1e-3),
+                      _mesh("data", 2), zero1=True, sentinel=True)
+    ts = dp.create_state(seed_key(0))
+    step = dp.make_train_step()
+    x, y = _lenet_batch()
+    return [Program("dp_sentinel", step.jitted, (ts, x, y))]
 
 
 def build_task4_mp() -> list[Program]:
@@ -312,6 +332,7 @@ ENTRYPOINTS: dict[str, Callable[[], list[Program]]] = {
     "task1_single": build_task1_single,
     "task2_dp": build_task2_dp,
     "dp_zero1": build_dp_zero1,
+    "dp_sentinel": build_dp_sentinel,
     "task4_mp": build_task4_mp,
     "fsdp": build_fsdp,
     "tp_fused": build_tp_fused,

@@ -43,6 +43,9 @@ RULES: dict[str, tuple[str, str]] = {
     "J110": (WARN, "decode-marked program recomputes full-sequence "
                    "attention per emitted token (O(T²) softmax inside the "
                    "per-token step)"),
+    "J111": (INFO, "optimizer update consumes gradients with no finiteness "
+                   "predicate anywhere in the step (one NaN microbatch "
+                   "poisons the weights unrecoverably)"),
     "A201": (WARN, "Python for/if over a traced (jnp/lax) value"),
     "A202": (WARN, "jax.random key consumed more than once without split"),
     "A203": (WARN, "epoch loop iterates a loader without set_epoch"),
@@ -69,6 +72,9 @@ HINTS: dict[str, str] = {
     "J110": "carry a KV cache through the decode loop "
             "(serve.ServingEngine / TransformerLM.apply_decode) so each "
             "step attends [B, H, 1, L] over cached K/V",
+    "J111": "wrap the optimizer with resilience.attach_sentinel (engines: "
+            "sentinel=True) so non-finite steps are skipped in-graph with "
+            "the previous state carried forward bit-exactly",
     "A201": "use lax.cond/lax.fori_loop/jnp.where, or materialize with "
             "float(...) first if this is host-side code",
     "A202": "key, sub = jax.random.split(key) before the second use",

@@ -43,6 +43,15 @@ multi-host slice:
         token — generation goes quadratic-per-token instead of reading
         the KV cache. The cache-carrying step's scores are [B, H, 1, L]
         (query dim 1) and stay silent.
+- J111  a training step that UPDATES parameters (≥2 elementwise ``sub``
+        equations whose minuend is a jaxpr invar, possibly through
+        reshape/concat/slice — the SGD/Adam ``p - update`` shape, incl.
+        ZeRO-1's flattened chunks) while the WHOLE program contains no
+        ``is_finite`` predicate: one non-finite microbatch then reaches
+        the weights and, under synchronous collectives, every replica at
+        once — the unrecoverable-divergence mode the step sentinel
+        (``resilience.GradSentinel``) closes. Sentinel-wrapped steps
+        carry the finiteness check in-graph and stay silent.
 
 The pass is backend-free: everything works on abstract values on CPU.
 """
@@ -101,6 +110,17 @@ _LASTDIM_PRESERVING = frozenset({"convert_element_type", "copy"})
 # Mesh axis names that conventionally carry data parallelism (J108 only
 # reasons about replicated WEIGHT updates, which live on these axes).
 _DATA_AXIS_NAMES = frozenset({"data", "batch"})
+
+# Primitives through which "this value is (a repartitioned view of) a
+# jaxpr invar" survives on the way to a parameter-update ``sub`` (J111
+# taint) — ZeRO-1 reshapes/concatenates/slices param leaves into flat
+# chunks before its inner update subtracts from them. Compute primitives
+# (dot, conv, reductions) deliberately KILL the taint: activations
+# derived from the batch never count as parameters.
+_J111_PRESERVING = frozenset({
+    "reshape", "concatenate", "slice", "dynamic_slice",
+    "convert_element_type", "transpose", "squeeze", "copy",
+})
 
 
 def _repo_rel(path: str) -> str:
@@ -476,6 +496,77 @@ def _check_replicated_update(eqn, entrypoint: str,
         ))
 
 
+def _has_isfinite(obj) -> bool:
+    """True if ``is_finite`` appears anywhere in the program (J111's
+    silence condition — the sentinel's in-graph grad check)."""
+    jaxpr, _ = _inner_jaxpr(obj)
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "is_finite":
+            return True
+        for sub, _extra in _sub_jaxprs(eqn):
+            if _has_isfinite(sub):
+                return True
+    return False
+
+
+def _count_param_update_subs(obj, acc: dict) -> None:
+    """Count, per jaxpr level, elementwise ``sub`` equations whose
+    minuend is taint-derived from one of THAT level's invars through
+    shape-repartitioning ops only — the ``p - update`` signature of an
+    optimizer step (params enter every level as invars; activations lose
+    the taint at the first dot/conv/reduce)."""
+    jaxpr, _ = _inner_jaxpr(obj)
+    tainted = set(id(v) for v in jaxpr.invars)
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        in_tainted = any(
+            id(v) in tainted for v in eqn.invars if hasattr(v, "aval")
+        )
+        if name in _J111_PRESERVING and in_tainted:
+            tainted.update(id(v) for v in eqn.outvars)
+        elif name == "sub" and eqn.invars:
+            op0 = eqn.invars[0]
+            shape = tuple(getattr(getattr(op0, "aval", None), "shape", ()))
+            out_shape = tuple(
+                getattr(getattr(eqn.outvars[0], "aval", None), "shape", ())
+            )
+            if (
+                id(op0) in tainted
+                and shape
+                and shape == out_shape
+            ):
+                acc["count"] += 1
+                f, ln = _src_loc(eqn)
+                per_file = acc["by_file"].setdefault(f, [0, ln])
+                per_file[0] += 1
+        for sub, _extra in _sub_jaxprs(eqn):
+            _count_param_update_subs(sub, acc)
+
+
+def _check_unguarded_update(closed, entrypoint: str,
+                            findings: list[Finding]) -> None:
+    """J111 for one traced program: it writes parameters (≥2 invar-
+    derived elementwise subs) yet never evaluates ``is_finite`` — no
+    finiteness gate stands between the gradients and the weights."""
+    acc: dict = {"count": 0, "by_file": {}}
+    _count_param_update_subs(closed, acc)
+    if acc["count"] < 2 or _has_isfinite(closed):
+        return
+    # Anchor at the file contributing the MOST update subs — the
+    # optimizer itself, not an incidental tainted sub elsewhere (a loss
+    # kernel's shift-by-max on a weight invar) — so one allowlist entry
+    # covers every engine sharing that optimizer.
+    f, (_, ln) = max(acc["by_file"].items(), key=lambda kv: kv[1][0])
+    findings.append(Finding(
+        "J111",
+        f"optimizer update writes {acc['count']} parameter tensors "
+        f"(invar-derived elementwise subs) but the step evaluates no "
+        f"is_finite predicate — a single non-finite microbatch reaches "
+        f"the weights on every replica at once",
+        file=f, line=ln, entrypoint=entrypoint,
+    ))
+
+
 def _walk(obj, bound: frozenset[str], entrypoint: str,
           findings: list[Finding]) -> None:
     jaxpr, consts = _inner_jaxpr(obj)
@@ -548,10 +639,11 @@ def _check_consts(consts, entrypoint: str, findings: list[Finding]) -> None:
 
 
 def analyze_closed_jaxpr(closed, entrypoint: str = "") -> list[Finding]:
-    """All jaxpr-level findings (J101-J105, J107-J110) for one traced
+    """All jaxpr-level findings (J101-J105, J107-J111) for one traced
     program."""
     findings: list[Finding] = []
     _walk(closed, frozenset(), entrypoint, findings)
+    _check_unguarded_update(closed, entrypoint, findings)
     return findings
 
 

@@ -17,26 +17,45 @@ Design notes (TPU-first):
   corrupts the latest checkpoint (required for preemptible TPU pods).
 - Restore takes a *target* pytree (e.g. a freshly built TrainState) and
   refills its leaves, so the treedef never needs serialising.
+- Integrity (format 2): every leaf/shard carries a CRC-32 in the
+  manifest; restores verify by default and raise
+  :class:`CheckpointCorruptError` instead of silently loading damaged
+  state. :func:`restore_latest_valid` (and the sharded counterpart)
+  walks ``step_*`` dirs newest-first past corrupt or partial
+  checkpoints, and :class:`CheckpointManager` retention never deletes
+  the only valid checkpoint.
 """
 
 from tpudml.checkpoint.sharded import (
+    restore_latest_valid_sharded,
     restore_sharded_checkpoint,
     save_sharded_checkpoint,
+    verify_sharded_checkpoint,
 )
 from tpudml.checkpoint.store import (
+    CheckpointCorruptError,
+    CheckpointHook,
     CheckpointManager,
     checkpoint_hook,
     latest_checkpoint,
     restore_checkpoint,
+    restore_latest_valid,
     save_checkpoint,
+    verify_checkpoint,
 )
 
 __all__ = [
+    "CheckpointCorruptError",
+    "CheckpointHook",
     "CheckpointManager",
     "checkpoint_hook",
     "latest_checkpoint",
     "restore_checkpoint",
+    "restore_latest_valid",
+    "restore_latest_valid_sharded",
     "restore_sharded_checkpoint",
     "save_checkpoint",
     "save_sharded_checkpoint",
+    "verify_checkpoint",
+    "verify_sharded_checkpoint",
 ]

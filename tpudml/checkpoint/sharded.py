@@ -14,6 +14,13 @@ each process writes exactly the shards it owns:
 - per-process files are written atomically (tmp + rename); the manifest
   records ``num_processes`` so restore can verify every host's file
   arrived before trusting the checkpoint;
+- every shard entry carries a CRC-32 of its encoded bytes (format 2,
+  mirroring the base store): ``restore_sharded_checkpoint(...,
+  verify=True)`` (the default) re-hashes each shard on read and raises
+  :class:`~tpudml.checkpoint.store.CheckpointCorruptError` on mismatch,
+  so a bit-flipped or truncated shard file can never silently poison a
+  resumed run; format-1 checkpoints (no CRCs) still restore with
+  structural checks only;
 - restore reads ALL shard files and reassembles full host arrays into the
   target pytree — placement back onto a mesh stays the caller's job
   (``jax.device_put`` with the engine's shardings), so any process
@@ -30,7 +37,12 @@ from typing import Any
 import jax
 import numpy as np
 
-from tpudml.checkpoint.store import _decode_leaf, _encode_leaf
+from tpudml.checkpoint.store import (
+    CheckpointCorruptError,
+    _crc,
+    _decode_leaf,
+    _encode_leaf,
+)
 from tpudml.core.dist import process_count, process_index
 
 PyTree = Any
@@ -76,6 +88,7 @@ def save_sharded_checkpoint(
                     tuple(slice(None)) * np.ndim(leaf), np.shape(leaf)
                 ),
                 "desc": desc,
+                "crc": _crc(arr),
             }
             continue
         for j, sh in enumerate(shards):
@@ -88,6 +101,7 @@ def save_sharded_checkpoint(
                 "leaf": i,
                 "index": _norm_index(sh.index, leaf.shape),
                 "desc": desc,
+                "crc": _crc(arr),
             }
     fd, tmp = tempfile.mkstemp(dir=path, suffix=".tmp.npz")
     os.close(fd)
@@ -98,6 +112,7 @@ def save_sharded_checkpoint(
         os.unlink(tmp)
         raise
     manifest = {
+        "format": 2,
         "step": int(step),
         "process": proc,
         "num_processes": process_count(),
@@ -115,24 +130,49 @@ def save_sharded_checkpoint(
     return path
 
 
-def restore_sharded_checkpoint(path: str | os.PathLike, target: PyTree) -> PyTree:
-    """Reassemble a sharded checkpoint into full host arrays shaped like
-    ``target``. Reads every process's shard file; verifies all hosts'
-    manifests are present and every element was covered by some shard."""
-    path = os.fspath(path)
+def _read_shard_manifests(path: str) -> list[dict]:
+    """All per-process manifests, validated for presence + agreement."""
     manifests = sorted(
         f for f in os.listdir(path) if f.startswith("manifest_p")
     )
     if not manifests:
-        raise FileNotFoundError(f"no shard manifests under {path}")
-    with open(os.path.join(path, manifests[0])) as f:
-        first = json.load(f)
+        raise CheckpointCorruptError(f"no shard manifests under {path}")
+    try:
+        with open(os.path.join(path, manifests[0])) as f:
+            first = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        raise CheckpointCorruptError(
+            f"{path}: unreadable shard manifest: {e!r}"
+        ) from e
     expect = first["num_processes"]
     if len(manifests) != expect:
-        raise ValueError(
+        raise CheckpointCorruptError(
             f"incomplete checkpoint: {len(manifests)}/{expect} process "
             f"manifests present under {path}"
         )
+    out = [first]
+    for k in range(1, expect):
+        try:
+            with open(os.path.join(path, _MANIFEST.format(k=k))) as f:
+                out.append(json.load(f))
+        except (OSError, json.JSONDecodeError) as e:
+            raise CheckpointCorruptError(
+                f"{path}: unreadable shard manifest p{k}: {e!r}"
+            ) from e
+    return out
+
+
+def restore_sharded_checkpoint(
+    path: str | os.PathLike, target: PyTree, *, verify: bool = True
+) -> PyTree:
+    """Reassemble a sharded checkpoint into full host arrays shaped like
+    ``target``. Reads every process's shard file; verifies all hosts'
+    manifests are present and every element was covered by some shard.
+    With ``verify`` (default) each shard's CRC-32 is re-checked against
+    the manifest; mismatches raise :class:`CheckpointCorruptError`."""
+    path = os.fspath(path)
+    manifests = _read_shard_manifests(path)
+    first = manifests[0]
     target_leaves, treedef = jax.tree.flatten(target)
     if first["num_leaves"] != len(target_leaves):
         raise ValueError(
@@ -141,13 +181,30 @@ def restore_sharded_checkpoint(path: str | os.PathLike, target: PyTree) -> PyTre
         )
     out = [None] * len(target_leaves)
     filled = [None] * len(target_leaves)
-    for k in range(expect):
-        with open(os.path.join(path, _MANIFEST.format(k=k))) as f:
-            meta = json.load(f)["entries"]
-        with np.load(os.path.join(path, _NPZ.format(k=k))) as data:
+    for k, man in enumerate(manifests):
+        meta = man["entries"]
+        try:
+            data_ctx = np.load(os.path.join(path, _NPZ.format(k=k)))
+        except Exception as e:  # missing/truncated npz payload
+            raise CheckpointCorruptError(
+                f"{path}: unreadable shard file p{k}: {e!r}"
+            ) from e
+        with data_ctx as data:
             for key, ent in meta.items():
                 i = ent["leaf"]
-                shard = _decode_leaf(data[key], ent["desc"])
+                try:
+                    raw = data[key]
+                except Exception as e:
+                    raise CheckpointCorruptError(
+                        f"{path}: shard {key} missing or undecodable in "
+                        f"p{k} payload: {e!r}"
+                    ) from e
+                if verify and "crc" in ent and _crc(raw) != ent["crc"]:
+                    raise CheckpointCorruptError(
+                        f"{path}: shard {key} (process {k}) failed CRC "
+                        "verification — checkpoint is corrupt"
+                    )
+                shard = _decode_leaf(raw, ent["desc"])
                 window = tuple(slice(a, b) for a, b in ent["index"])
                 if out[i] is None:
                     # Windows only bound shards; the target supplies the
@@ -164,3 +221,69 @@ def restore_sharded_checkpoint(path: str | os.PathLike, target: PyTree) -> PyTre
                 "(corrupt or topology-incompatible checkpoint)"
             )
     return jax.tree.unflatten(treedef, out)
+
+
+def verify_sharded_checkpoint(path: str | os.PathLike) -> int:
+    """Full integrity check of one sharded ``step_*`` dir WITHOUT needing
+    a target tree: all process manifests present, every shard decodable,
+    every recorded CRC matching. Returns the checkpoint's step. Raises
+    :class:`CheckpointCorruptError` on any defect."""
+    path = os.fspath(path)
+    manifests = _read_shard_manifests(path)
+    for k, man in enumerate(manifests):
+        try:
+            data_ctx = np.load(os.path.join(path, _NPZ.format(k=k)))
+        except Exception as e:
+            raise CheckpointCorruptError(
+                f"{path}: unreadable shard file p{k}: {e!r}"
+            ) from e
+        with data_ctx as data:
+            for key, ent in man["entries"].items():
+                try:
+                    raw = data[key]
+                except Exception as e:
+                    raise CheckpointCorruptError(
+                        f"{path}: shard {key} missing or undecodable in "
+                        f"p{k} payload: {e!r}"
+                    ) from e
+                if "crc" in ent and _crc(raw) != ent["crc"]:
+                    raise CheckpointCorruptError(
+                        f"{path}: shard {key} (process {k}) failed CRC "
+                        "verification — checkpoint is corrupt"
+                    )
+    return int(manifests[0]["step"])
+
+
+def restore_latest_valid_sharded(
+    directory: str | os.PathLike, target: PyTree, *, verify: bool = True
+) -> PyTree:
+    """Sharded counterpart of
+    :func:`tpudml.checkpoint.store.restore_latest_valid`: walk the
+    ``step_*`` dirs newest-first, restore the first one that passes
+    verification, warn (stderr) about each corrupt/partial dir skipped.
+    Returns ``target`` untouched when no step dirs exist; raises
+    :class:`CheckpointCorruptError` when step dirs exist but none is
+    restorable."""
+    import sys
+
+    from tpudml.checkpoint.store import _all_step_dirs
+
+    directory = os.fspath(directory)
+    dirs = _all_step_dirs(directory)
+    if not dirs:
+        return target
+    failures = []
+    for step, path in reversed(dirs):
+        try:
+            return restore_sharded_checkpoint(path, target, verify=verify)
+        except (CheckpointCorruptError, ValueError, OSError, KeyError) as e:
+            failures.append(f"step_{step}: {e}")
+            print(
+                f"[tpudml.checkpoint] skipping invalid sharded checkpoint "
+                f"step_{step}: {e}",
+                file=sys.stderr,
+            )
+    raise CheckpointCorruptError(
+        f"no valid sharded checkpoint under {directory}; tried "
+        + "; ".join(failures)
+    )

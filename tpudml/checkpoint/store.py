@@ -1,4 +1,15 @@
-"""Pytree checkpoint store: atomic npz + manifest, process-0 writes."""
+"""Pytree checkpoint store: atomic npz + manifest, process-0 writes.
+
+Integrity model (format 2): the manifest carries a CRC-32 per encoded
+leaf, computed over exactly the bytes that land in ``leaves.npz``.
+``restore_checkpoint`` verifies them by default before decoding, so a
+truncated payload, a flipped bit, or a missing file is a
+:class:`CheckpointCorruptError` — never silently-wrong params.
+:func:`restore_latest_valid` turns that detection into fallback: walk
+``step_*`` dirs newest-first and restore the first checkpoint that
+verifies, skipping vandalized/partial ones. Format-1 checkpoints (no
+``checksums`` key) still verify structurally (every leaf readable).
+"""
 
 from __future__ import annotations
 
@@ -10,6 +21,7 @@ import shutil
 import sys
 import tempfile
 import threading
+import zlib
 from typing import Any, Callable
 
 import jax
@@ -22,6 +34,14 @@ PyTree = Any
 _MANIFEST = "manifest.json"
 _LEAVES = "leaves.npz"
 _STEP_DIR = re.compile(r"^step_(\d+)$")
+
+
+class CheckpointCorruptError(ValueError):
+    """A checkpoint failed verification (missing/truncated/corrupt)."""
+
+
+def _crc(arr: np.ndarray) -> int:
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF
 
 
 def _resolve_dtype(name: str) -> np.dtype:
@@ -86,10 +106,11 @@ def save_checkpoint(
         # allgathered (a collective — all processes must participate).
         leaves = [_fetch_leaf(x) for x in jax.tree.leaves(tree)]
         if process_index() == 0:
-            arrays, descs = {}, {}
+            arrays, descs, checksums = {}, {}, {}
             for i, leaf in enumerate(leaves):
                 arr, desc = _encode_leaf(np.asarray(leaf))
                 arrays[f"leaf_{i:05d}"] = arr
+                checksums[f"leaf_{i:05d}"] = _crc(arr)
                 if desc is not None:
                     descs[str(i)] = desc
             os.makedirs(directory, exist_ok=True)
@@ -97,9 +118,11 @@ def save_checkpoint(
             try:
                 np.savez(os.path.join(tmp, _LEAVES), **arrays)
                 manifest = {
+                    "format": 2,
                     "step": int(step),
                     "num_leaves": len(leaves),
                     "extended_dtypes": descs,
+                    "checksums": checksums,
                     "metadata": metadata or {},
                 }
                 with open(os.path.join(tmp, _MANIFEST), "w") as f:
@@ -132,17 +155,34 @@ def latest_checkpoint(directory: str | os.PathLike) -> str | None:
     return os.path.join(directory, f"step_{max(steps)}")
 
 
-def restore_checkpoint(path: str | os.PathLike, target: PyTree) -> PyTree:
+def _read_manifest(path: str) -> dict:
+    mpath = os.path.join(path, _MANIFEST)
+    try:
+        with open(mpath) as f:
+            return json.load(f)
+    except FileNotFoundError:
+        raise CheckpointCorruptError(f"{path}: missing {_MANIFEST}") from None
+    except (json.JSONDecodeError, OSError) as e:
+        raise CheckpointCorruptError(f"{path}: unreadable manifest: {e}") from e
+
+
+def restore_checkpoint(
+    path: str | os.PathLike, target: PyTree, *, verify: bool = True
+) -> PyTree:
     """Refill ``target``'s leaves from the checkpoint at ``path``.
 
     Every process reads the same files, so all hosts resume bitwise
     identical — the persistent form of the reference's start-of-training
     parameter broadcast (codes/task2/dist_utils.py:33-37). Dtypes follow
     the checkpoint; shapes must match the target's.
+
+    ``verify=True`` (default) checks each encoded leaf against the
+    manifest's CRC-32 before decoding and raises
+    :class:`CheckpointCorruptError` on any mismatch, truncation, or
+    unreadable file; ``verify=False`` trusts the bytes.
     """
     path = os.fspath(path)
-    with open(os.path.join(path, _MANIFEST)) as f:
-        manifest = json.load(f)
+    manifest = _read_manifest(path)
     target_leaves, treedef = jax.tree.flatten(target)
     if manifest["num_leaves"] != len(target_leaves):
         raise ValueError(
@@ -150,11 +190,24 @@ def restore_checkpoint(path: str | os.PathLike, target: PyTree) -> PyTree:
             f"{len(target_leaves)} — structure mismatch"
         )
     descs = manifest["extended_dtypes"]
-    with np.load(os.path.join(path, _LEAVES)) as data:
-        leaves = [
-            _decode_leaf(data[f"leaf_{i:05d}"], descs.get(str(i)))
-            for i in range(len(target_leaves))
-        ]
+    checksums = manifest.get("checksums", {})
+    leaves = []
+    try:
+        with np.load(os.path.join(path, _LEAVES)) as data:
+            for i in range(len(target_leaves)):
+                key = f"leaf_{i:05d}"
+                raw = data[key]
+                if verify and key in checksums and _crc(raw) != checksums[key]:
+                    raise CheckpointCorruptError(
+                        f"{path}: leaf {i} checksum mismatch (corrupt data)"
+                    )
+                leaves.append(_decode_leaf(raw, descs.get(str(i))))
+    except CheckpointCorruptError:
+        raise
+    except Exception as e:  # truncated zip, missing member, zlib error …
+        raise CheckpointCorruptError(
+            f"{path}: unreadable {_LEAVES}: {e!r}"
+        ) from e
     for i, (new, old) in enumerate(zip(leaves, target_leaves)):
         if hasattr(old, "shape") and tuple(new.shape) != tuple(np.shape(old)):
             raise ValueError(
@@ -162,6 +215,78 @@ def restore_checkpoint(path: str | os.PathLike, target: PyTree) -> PyTree:
                 f"shape {tuple(np.shape(old))}"
             )
     return jax.tree.unflatten(treedef, leaves)
+
+
+def verify_checkpoint(path: str | os.PathLike) -> int:
+    """Full integrity check of one ``step_`` dir; returns its step.
+
+    Raises :class:`CheckpointCorruptError` on a missing/unreadable
+    manifest, missing/truncated/unreadable ``leaves.npz``, or any leaf
+    whose CRC-32 disagrees with the manifest. Format-1 checkpoints
+    (no ``checksums``) pass if every leaf is structurally readable.
+    """
+    path = os.fspath(path)
+    manifest = _read_manifest(path)
+    checksums = manifest.get("checksums", {})
+    try:
+        with np.load(os.path.join(path, _LEAVES)) as data:
+            for i in range(int(manifest["num_leaves"])):
+                key = f"leaf_{i:05d}"
+                raw = data[key]
+                if key in checksums and _crc(raw) != checksums[key]:
+                    raise CheckpointCorruptError(
+                        f"{path}: leaf {i} checksum mismatch (corrupt data)"
+                    )
+    except CheckpointCorruptError:
+        raise
+    except Exception as e:
+        raise CheckpointCorruptError(
+            f"{path}: unreadable {_LEAVES}: {e!r}"
+        ) from e
+    return int(manifest["step"])
+
+
+def _all_step_dirs(directory: str) -> list[tuple[int, str]]:
+    """(step, path) of every ``step_`` dir, manifest or not, ascending."""
+    if not os.path.isdir(directory):
+        return []
+    out = []
+    for name in os.listdir(directory):
+        m = _STEP_DIR.match(name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(directory, name)))
+    return sorted(out)
+
+
+def restore_latest_valid(
+    directory: str | os.PathLike, target: PyTree, *, verify: bool = True
+) -> PyTree:
+    """Restore from the NEWEST checkpoint that verifies, walking
+    ``step_*`` dirs newest-first past corrupt/partial ones (each skip is
+    reported on stderr). Passthrough of ``target`` when the directory
+    holds no ``step_`` dirs at all (fresh start); raises
+    :class:`CheckpointCorruptError` when checkpoints exist but NONE is
+    restorable — silently restarting from scratch would discard the run.
+    """
+    directory = os.fspath(directory)
+    dirs = _all_step_dirs(directory)
+    if not dirs:
+        return target
+    failures = []
+    for step, path in reversed(dirs):
+        try:
+            return restore_checkpoint(path, target, verify=verify)
+        except (CheckpointCorruptError, ValueError, OSError, KeyError) as e:
+            failures.append(f"step_{step}: {e}")
+            print(
+                f"[tpudml.checkpoint] skipping invalid checkpoint "
+                f"step_{step}: {e}",
+                file=sys.stderr,
+            )
+    raise CheckpointCorruptError(
+        f"{directory}: no valid checkpoint among {len(dirs)} step dirs — "
+        + "; ".join(failures)
+    )
 
 
 class CheckpointManager:
@@ -246,7 +371,20 @@ class CheckpointManager:
         self._pending.start()
         return path
 
+    def _valid(self, step: int) -> bool:
+        try:
+            verify_checkpoint(os.path.join(self.directory, f"step_{step}"))
+            return True
+        except CheckpointCorruptError:
+            return False
+
     def _prune(self) -> None:
+        """Keep-last-K retention that never deletes the ONLY valid
+        checkpoint: when none of the K newest verifies (e.g. the latest
+        saves were vandalized/partial), the newest valid older step is
+        spared so ``restore_latest_valid`` always has a fallback. The
+        verification reads happen only when something is actually due
+        for deletion."""
         if process_index() != 0 or not os.path.isdir(self.directory):
             return
         steps = sorted(
@@ -254,7 +392,15 @@ class CheckpointManager:
             for name in os.listdir(self.directory)
             if (m := _STEP_DIR.match(name))
         )
-        for s in steps[: -self.keep] if self.keep > 0 else []:
+        if self.keep <= 0 or len(steps) <= self.keep:
+            return
+        kept, candidates = steps[-self.keep:], steps[: -self.keep]
+        if not any(self._valid(s) for s in kept):
+            for s in reversed(candidates):
+                if self._valid(s):
+                    candidates = [c for c in candidates if c != s]
+                    break
+        for s in candidates:
             shutil.rmtree(os.path.join(self.directory, f"step_{s}"), True)
 
     def latest_step(self) -> int | None:
@@ -264,12 +410,12 @@ class CheckpointManager:
             return None
         return int(_STEP_DIR.match(os.path.basename(path)).group(1))
 
-    def restore_latest(self, target: PyTree) -> PyTree:
+    def restore_latest(self, target: PyTree, *, verify: bool = True) -> PyTree:
+        """Restore the newest VALID checkpoint (falling back past corrupt
+        ones — see :func:`restore_latest_valid`); passthrough if the
+        directory holds no checkpoints."""
         self.wait()
-        path = latest_checkpoint(self.directory)
-        if path is None:
-            return target
-        return restore_checkpoint(path, target)
+        return restore_latest_valid(self.directory, target, verify=verify)
 
 
 def checkpoint_hook(manager: CheckpointManager, every: int) -> Callable:
@@ -294,3 +440,21 @@ def checkpoint_hook(manager: CheckpointManager, every: int) -> Callable:
             manager.save(train_state, global_step, metadata={"epoch": epoch})
 
     return hook
+
+
+class CheckpointHook:
+    """Object form of :func:`checkpoint_hook` for step-granular resume:
+    ``CheckpointHook(manager, every_n_steps=50)`` saves every N optimizer
+    steps mid-epoch; combined with ``train_loop``'s fast-forwarding
+    restore, a run preempted between epoch boundaries resumes bit-exact
+    from the last saved step instead of redoing the partial epoch."""
+
+    def __init__(self, manager: CheckpointManager, every_n_steps: int):
+        if every_n_steps < 1:
+            raise ValueError("every_n_steps must be >= 1")
+        self.manager = manager
+        self.every_n_steps = every_n_steps
+        self._hook = checkpoint_hook(manager, every_n_steps)
+
+    def __call__(self, **kwargs) -> None:
+        self._hook(**kwargs)

@@ -40,6 +40,17 @@ class ClusterSpec:
     # each restart continues from the last checkpoint (SURVEY.md §5.3/5.4:
     # checkpoint/restart IS the recovery story).
     max_restarts: int = 0
+    # Seeded exponential backoff between restart attempts: attempt k waits
+    # restart_backoff_s * restart_backoff_factor**(k-1), plus a uniform
+    # jitter of up to restart_backoff_jitter × that delay drawn from
+    # random.Random(restart_backoff_seed) — deterministic per spec, but
+    # decorrelated across jobs so a mass preemption doesn't produce a
+    # thundering-herd reconnect. 0 (the default) restarts immediately,
+    # preserving the pre-backoff behaviour.
+    restart_backoff_s: float = 0.0
+    restart_backoff_factor: float = 2.0
+    restart_backoff_jitter: float = 0.0
+    restart_backoff_seed: int = 0
     # Straggler/fault injection (task2 bottleneck-node experiment).
     bottleneck_rank: int | None = None
     bottleneck_delay_s: float = 0.1

@@ -3,12 +3,13 @@
 from __future__ import annotations
 
 import dataclasses
+import random
 import signal
 import subprocess
 import sys
 import threading
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 from tpudml.launch.cluster import ClusterSpec
 
@@ -22,6 +23,9 @@ class LaunchResult:
     timed_out: bool = False
     failed_rank: int | None = None
     attempts: int = 1
+    # Backoff delay actually slept before each restart (empty when the
+    # job succeeded first try or restart_backoff_s == 0).
+    backoffs_s: list[float] = field(default_factory=list)
 
     @property
     def success(self) -> bool:
@@ -66,7 +70,10 @@ def launch(
     timed-out job is relaunched whole (fresh rendezvous port) up to that
     many times — combine with the tasks' ``--ckpt_dir ... --resume`` flags
     so restarts continue from the last checkpoint. ``attempts`` on the
-    result counts the runs.
+    result counts the runs. ``spec.restart_backoff_s`` > 0 inserts a
+    seeded exponential (+ jitter) delay before each relaunch — recorded
+    per attempt in ``result.backoffs_s`` and charged against
+    ``timeout_s`` like any other elapsed time.
     """
     spec = spec or ClusterSpec()
     out = sink or sys.stdout
@@ -83,21 +90,45 @@ def launch(
             timeout_s=remaining,
         )
 
+    # Seeded restart backoff: deterministic per (spec, seed) so restart
+    # cadence is reproducible in tests, decorrelated across jobs by seed.
+    rng = random.Random(spec.restart_backoff_seed)
+
+    def backoff_for(attempt: int) -> float:
+        if spec.restart_backoff_s <= 0:
+            return 0.0
+        delay = spec.restart_backoff_s * spec.restart_backoff_factor ** (
+            attempt - 1
+        )
+        if spec.restart_backoff_jitter > 0:
+            delay += rng.uniform(0, spec.restart_backoff_jitter * delay)
+        return delay
+
     result = _launch_once(cmd, attempt_spec(budget), sink)
     total_elapsed = result.elapsed_s
+    backoffs: list[float] = []
     attempt = 1
     while not result.success and attempt <= spec.max_restarts:
-        remaining = None if budget is None else budget - total_elapsed
+        delay = backoff_for(attempt)
+        remaining = None if budget is None else budget - total_elapsed - delay
         if remaining is not None and remaining <= 0:
             break  # whole-job budget exhausted — don't relaunch
         why = "timeout" if result.timed_out else f"rank {result.failed_rank} failed"
-        out.write(f"[launch] {why}; restart {attempt}/{spec.max_restarts}\n")
+        tail = f" after {delay:.2f}s backoff" if delay > 0 else ""
+        out.write(
+            f"[launch] {why}; restart {attempt}/{spec.max_restarts}{tail}\n"
+        )
         out.flush()
+        if delay > 0:
+            time.sleep(delay)
+            total_elapsed += delay
+        backoffs.append(delay)
         result = _launch_once(cmd, attempt_spec(remaining), sink)
         total_elapsed += result.elapsed_s
         attempt += 1
     result.attempts = attempt
     result.elapsed_s = total_elapsed
+    result.backoffs_s = backoffs
     return result
 
 

@@ -82,6 +82,7 @@ class DataParallel:
         save_scores: bool | None = None,
         zero1: bool = False,
         zero1_overlap: bool = False,
+        sentinel: bool | dict = False,
     ):
         if save_scores and not fused_xent:
             raise ValueError("save_scores requires fused_xent=True")
@@ -163,6 +164,20 @@ class DataParallel:
             self.optimizer = ZeRO1(
                 optimizer, axis_name=axis_name, world=self.world
             )
+        # In-graph step sentinel (tpudml.resilience): under zero1 it is
+        # inserted INSIDE the ZeRO1 wrapper — the chunk grads it then
+        # guards are disjoint over the data axis, so attach_sentinel
+        # psums the anomaly predicate over it; without zero1 the grads
+        # are already aggregated when the optimizer runs (and the
+        # measure_comm split step applies it OUTSIDE shard_map), so the
+        # predicate needs no collective at all.
+        self.sentinel = None
+        if sentinel:
+            from tpudml.resilience.sentinel import attach_sentinel, find_sentinel
+
+            kw = dict(sentinel) if isinstance(sentinel, dict) else {}
+            self.optimizer = attach_sentinel(self.optimizer, (), **kw)
+            self.sentinel = find_sentinel(self.optimizer)
         self._param_template = None
         self._gather_fn = None
         # Dense-MoE runs get the Switch load-balancing pressure by default
@@ -322,6 +337,19 @@ class DataParallel:
             return self._make_split_step()
         return self._make_fused_step()
 
+    def _agg_metrics(self, local: dict) -> dict:
+        """Cross-replica metric aggregation: means, except the sentinel's
+        ``bad_micro`` index which is a max (-1 means clean; a mean over
+        replicas would mangle the integer)."""
+        return {
+            k: (
+                jax.lax.pmax(v, self.axis_name)
+                if k == "bad_micro"
+                else jax.lax.pmean(v, self.axis_name)
+            )
+            for k, v in local.items()
+        }
+
     def _spmd_body(self, ts: TrainState, images, labels):
         """Per-shard step body (runs under shard_map)."""
         rng = None
@@ -331,15 +359,16 @@ class DataParallel:
                 jax.random.fold_in(self.rng_root, ts.step),
                 jax.lax.axis_index(self.axis_name),
             )
+        taint = self.sentinel is not None
         if self.fused_xent:
             grads, model_state, local = accumulate_fused_grads(
                 self._fused_loss_fn, ts.params, ts.model_state, images,
-                labels, rng, self.accum_steps,
+                labels, rng, self.accum_steps, taint=taint,
             )
         else:
             grads, model_state, local = accumulate_grads(
                 self._loss_fn, ts.params, ts.model_state, images, labels, rng,
-                self.accum_steps,
+                self.accum_steps, taint=taint,
             )
         if not self.zero1:
             # Under zero1 the reduce-scatter inside optimizer.update IS
@@ -352,9 +381,7 @@ class DataParallel:
         # keeps params/state replicated).
         model_state = pmean_tree(model_state, self.axis_name)
         new_params, new_opt = self.optimizer.update(grads, ts.opt_state, ts.params)
-        metrics = {
-            k: jax.lax.pmean(v, self.axis_name) for k, v in local.items()
-        }
+        metrics = self._agg_metrics(local)
         new_ts = TrainState(
             params=new_params,
             model_state=model_state,
@@ -380,21 +407,20 @@ class DataParallel:
                 jax.random.fold_in(self.rng_root, ts.step),
                 jax.lax.axis_index(self.axis_name),
             )
+        taint = self.sentinel is not None
         if self.fused_xent:
             grads, model_state, local = accumulate_fused_grads(
                 self._fused_loss_fn, params, ts.model_state, images, labels,
-                rng, self.accum_steps,
+                rng, self.accum_steps, taint=taint,
             )
         else:
             grads, model_state, local = accumulate_grads(
                 self._loss_fn, params, ts.model_state, images, labels, rng,
-                self.accum_steps,
+                self.accum_steps, taint=taint,
             )
         model_state = pmean_tree(model_state, self.axis_name)
         new_chunks, new_opt = opt.update_shards(grads, ts.opt_state, ts.params)
-        metrics = {
-            k: jax.lax.pmean(v, self.axis_name) for k, v in local.items()
-        }
+        metrics = self._agg_metrics(local)
         new_ts = TrainState(
             params=new_chunks,
             model_state=model_state,

@@ -114,6 +114,7 @@ class FSDP(GSPMDParallel):
         aux_loss_weight: float | None = None,
         fused_xent: bool = False,
         save_scores: bool | None = None,
+        sentinel: bool | dict = False,
     ):
         if axis_name not in mesh.shape:
             raise ValueError(
@@ -134,4 +135,5 @@ class FSDP(GSPMDParallel):
             aux_loss_weight=aux_loss_weight,
             fused_xent=fused_xent,
             save_scores=save_scores,
+            sentinel=sentinel,
         )

@@ -179,6 +179,7 @@ class GSPMDParallel:
         aux_loss_weight: float | None = None,
         fused_xent: bool = False,
         save_scores: bool | None = None,
+        sentinel: bool | dict = False,
     ):
         if save_scores and not fused_xent:
             raise ValueError("save_scores requires fused_xent=True")
@@ -189,6 +190,17 @@ class GSPMDParallel:
             )
         self.model = model
         self.optimizer = optimizer
+        # In-graph step sentinel (tpudml.resilience): under jit/GSPMD the
+        # grads the optimizer consumes are logically global arrays —
+        # isfinite/norm reductions compile to the right collectives
+        # automatically, so the wrapper needs no explicit axis psum.
+        self.sentinel = None
+        if sentinel:
+            from tpudml.resilience.sentinel import attach_sentinel, find_sentinel
+
+            kw = dict(sentinel) if isinstance(sentinel, dict) else {}
+            self.optimizer = attach_sentinel(self.optimizer, (), **kw)
+            self.sentinel = find_sentinel(self.optimizer)
         self.mesh = mesh
         self.axis_name = axis_name
         if rule is None and axis_name not in mesh.shape:
@@ -286,7 +298,7 @@ class GSPMDParallel:
             else:
                 grads, model_state, metrics = accumulate_grads(
                     self._loss_fn, ts.params, ts.model_state, images, labels,
-                    rng, self.accum_steps,
+                    rng, self.accum_steps, taint=self.sentinel is not None,
                 )
             new_params, new_opt = self.optimizer.update(grads, ts.opt_state, ts.params)
             new_ts = TrainState(

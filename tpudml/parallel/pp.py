@@ -141,6 +141,7 @@ class GPipe:
         loss: Callable = softmax_cross_entropy,
         remat: bool = False,
         batch_axis: str | None = None,
+        sentinel: bool | dict = False,
     ):
         self.block = block
         self.remat = remat
@@ -186,6 +187,22 @@ class GPipe:
             # Stage leaves carry a leading stage-stacked dim the chunking
             # must preserve (state specs become P(stage, data)).
             self.optimizer = with_stacked(self.optimizer, stages_stacked)
+        # In-graph step sentinel (tpudml.resilience): the update runs
+        # inside shard_map on stage-LOCAL grads (prologue/epilogue
+        # replicated over stage), so the anomaly predicate psums over the
+        # stage axis; attach_sentinel appends the data axis when a ZeRO1
+        # chunks the grads over it too.
+        self.sentinel = None
+        if sentinel:
+            if self.optimizer is None:
+                raise ValueError("sentinel needs an optimizer")
+            from tpudml.resilience.sentinel import attach_sentinel, find_sentinel
+
+            kw = dict(sentinel) if isinstance(sentinel, dict) else {}
+            self.optimizer = attach_sentinel(
+                self.optimizer, (axis_name,), **kw
+            )
+            self.sentinel = find_sentinel(self.optimizer)
         self.prologue = prologue
         self.epilogue = epilogue
         self.loss = loss

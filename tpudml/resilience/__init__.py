@@ -1,0 +1,50 @@
+"""tpudml.resilience — fault tolerance for the training and serving path.
+
+Three parts (docs/RESILIENCE.md is the user guide):
+
+- :mod:`sentinel` — :class:`GradSentinel`, an in-graph step guard in the
+  same pure optimizer-wrapper style as :mod:`tpudml.optim.zero1`: grad
+  finiteness (and an optional norm-spike test) is evaluated INSIDE the
+  jitted step and anomalous updates are suppressed by a branch-free
+  select, carrying the previous ``TrainState`` forward bit-exactly.
+- checkpoint integrity + fallback — lives in :mod:`tpudml.checkpoint`
+  (per-leaf checksums, ``verify=True`` restores,
+  ``restore_latest_valid``); re-exported here for discoverability.
+- :mod:`faults` — a seeded, deterministic fault-injection harness
+  (microbatch corruptors, rank killer, straggler, checkpoint vandals)
+  that the resilience tests use to PROVE the above end to end.
+"""
+
+from tpudml.resilience.faults import (
+    VANDALS,
+    corrupt_microbatch,
+    rank_kill_hook,
+    straggler_hook,
+    vandalize,
+)
+from tpudml.resilience.sentinel import (
+    GradSentinel,
+    SentinelTripped,
+    attach_sentinel,
+    find_sentinel,
+    find_sentinel_state,
+    param_leaf_names,
+    sentinel_hook,
+    sentinel_stats,
+)
+
+__all__ = [
+    "GradSentinel",
+    "SentinelTripped",
+    "VANDALS",
+    "attach_sentinel",
+    "corrupt_microbatch",
+    "find_sentinel",
+    "find_sentinel_state",
+    "param_leaf_names",
+    "rank_kill_hook",
+    "sentinel_hook",
+    "sentinel_stats",
+    "straggler_hook",
+    "vandalize",
+]

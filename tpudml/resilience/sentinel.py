@@ -1,0 +1,321 @@
+"""In-graph step sentinel as a pure optimizer-wrapper transform.
+
+One poisoned gradient poisons every replica under synchronous
+collectives (SURVEY.md §5.3): after the allreduce there is no clean copy
+left to fall back to, and a single NaN microbatch turns the whole run
+into NaN from that step on. :class:`GradSentinel` closes the numerical
+half of that failure mode the same way :class:`~tpudml.optim.zero1.ZeRO1`
+closed the optimizer-FLOPs half — as a wrapper any engine composes with
+through its existing ``optimizer.update`` call site:
+
+- global grad finiteness (every leaf, every element) and an optional
+  grad-norm spike test against a running EMA are evaluated INSIDE the
+  jitted program — no host sync, no callbacks, nothing for J103 to flag;
+- on anomaly the update is suppressed with a branch-free
+  ``jnp.where`` select over the whole ``(params, base_state)`` tree:
+  the previous values are carried forward BIT-EXACTLY (a skipped step
+  is indistinguishable from that batch never having arrived), the base
+  optimizer's internal clock (Adam's ``t``) does not advance, and a
+  device-side skip counter increments;
+- a consecutive-skip budget escalates host-side: :func:`sentinel_hook`
+  periodically reads the counters and raises :class:`SentinelTripped`
+  with a diagnostic naming the first non-finite leaf (and, when the
+  engine runs gradient accumulation with taint tracking, the poisoned
+  microbatch index from ``metrics["bad_micro"]``).
+
+Why select instead of ``lax.cond``: the base update may contain
+collectives (ZeRO-1's reduce-scatter/all-gather, a sharded clip's psum),
+and a cond whose branches issue different collective sequences is
+exactly the J102 deadlock class. Always executing the update and
+selecting the result keeps the collective schedule identical on every
+device; the NaN flowing through the unselected operand is discarded by
+the select.
+
+Placement (``attach_sentinel`` does this for you): OUTERMOST for plain
+optimizers, but INSIDE a :class:`ZeRO1` wrapper — the sentinel then
+guards the post-reduce-scatter chunk gradients, the ZeRO-1 overlap
+machinery (``update_shards``/``gather_params``) is untouched, and on a
+skip the all-gather of the unselected old chunks reproduces the old
+params bit-exactly. ``axis_names`` lists the mesh axes over which the
+gradients seen at the wrapper's position may DIVERGE across devices
+(ZeRO-1 chunks over the data axis, pipeline stage-local grads over the
+stage axis); the bad flag and norm are psum'd over them so every device
+agrees on the skip decision. Engines whose grads are already globally
+consistent at the update site (plain DP post-allreduce, GSPMD/FSDP/TP
+under jit) use ``axis_names=()``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from tpudml.optim.optimizers import Optimizer
+from tpudml.optim.zero1 import ZeRO1
+
+PyTree = Any
+
+#: keys that identify a GradSentinel state dict inside a nested opt_state
+_STATE_KEYS = frozenset(
+    {"base", "skips", "consecutive", "good_steps", "norm_ema", "bad_leaf"}
+)
+
+
+class SentinelTripped(RuntimeError):
+    """Raised host-side when the consecutive-skip budget is exceeded."""
+
+
+@dataclass(frozen=True)
+class GradSentinel(Optimizer):
+    """Suppress non-finite / spiking updates inside the jitted step.
+
+    ``axis_names``: mesh axes over which the grads at this position in
+    the optimizer chain may differ per device — the anomaly predicate is
+    psum'd over them so the skip decision is globally consistent (see
+    module docstring for per-engine values). ``spike_factor`` > 0 also
+    skips steps whose global grad norm exceeds ``spike_factor ×`` a
+    running EMA (decay ``ema_decay``), armed only after ``warmup_steps``
+    non-skipped steps so early-training noise cannot trip it.
+    ``skip_budget`` is the number of CONSECUTIVE skips tolerated before
+    :func:`sentinel_hook` escalates; the in-graph path never raises.
+    """
+
+    base: Optimizer = None  # type: ignore[assignment]
+    axis_names: tuple[str, ...] = ()
+    skip_budget: int = 3
+    spike_factor: float = 0.0
+    ema_decay: float = 0.99
+    warmup_steps: int = 10
+
+    def __post_init__(self):
+        if self.base is None:
+            raise ValueError("GradSentinel needs a base optimizer")
+        if self.skip_budget < 1:
+            raise ValueError("skip_budget must be >= 1")
+        if self.spike_factor and self.spike_factor <= 1.0:
+            raise ValueError(
+                "spike_factor must be > 1 (a ratio vs the running norm "
+                "EMA) or 0 to disable the spike test"
+            )
+
+    # -- Optimizer contract -----------------------------------------------
+
+    def init(self, params):
+        # Distinct arrays per counter: engines donate the TrainState, and
+        # XLA rejects the same buffer donated at two argument positions.
+        return {
+            "base": self.base.init(params),
+            "skips": jnp.zeros((), jnp.int32),
+            "consecutive": jnp.zeros((), jnp.int32),
+            "good_steps": jnp.zeros((), jnp.int32),
+            "norm_ema": jnp.zeros((), jnp.float32),
+            "bad_leaf": jnp.full((), -1, jnp.int32),
+        }
+
+    def init_spec(self, param_specs):
+        return {
+            "base": self.base.init_spec(param_specs),
+            "skips": P(),
+            "consecutive": P(),
+            "good_steps": P(),
+            "norm_ema": P(),
+            "bad_leaf": P(),
+        }
+
+    def _psum(self, x):
+        for axis in self.axis_names:
+            x = lax.psum(x, axis)
+        return x
+
+    def update(self, grads, state, params):
+        leaves = jax.tree_util.tree_leaves(grads)
+        # Per-leaf non-finite element counts, psum'd so devices holding
+        # different shards (ZeRO-1 chunks, pipeline stages) agree; the
+        # argmax below names the FIRST bad leaf for the host diagnostic.
+        bad_per_leaf = jnp.stack(
+            [jnp.sum(~jnp.isfinite(g), dtype=jnp.int32) for g in leaves]
+        )
+        bad_per_leaf = self._psum(bad_per_leaf)
+        nonfinite = jnp.any(bad_per_leaf > 0)
+        bad_leaf_now = jnp.where(
+            nonfinite, jnp.argmax(bad_per_leaf > 0).astype(jnp.int32), -1
+        )
+
+        normsq = self._psum(
+            sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves)
+        )
+        # A non-finite grad makes normsq non-finite too; keep the EMA
+        # clean by never folding skipped steps into it (below).
+        norm = jnp.sqrt(normsq)
+        skip = nonfinite
+        if self.spike_factor:
+            armed = state["good_steps"] >= self.warmup_steps
+            spike = armed & (norm > self.spike_factor * state["norm_ema"])
+            skip = skip | spike
+
+        # Always run the base update (identical collective schedule on
+        # every device — see module docstring), then select old vs new.
+        new_params, new_base = self.base.update(grads, state["base"], params)
+        keep_old = lambda old, new: jax.tree_util.tree_map(
+            lambda o, n: jnp.where(skip, o, n), old, new
+        )
+        out_params = keep_old(params, new_params)
+        out_base = keep_old(state["base"], new_base)
+
+        good = jnp.where(skip, 0, 1).astype(jnp.int32)
+        new_ema = jnp.where(
+            skip,
+            state["norm_ema"],
+            jnp.where(
+                state["good_steps"] == 0,
+                norm,
+                self.ema_decay * state["norm_ema"]
+                + (1.0 - self.ema_decay) * norm,
+            ),
+        )
+        new_state = {
+            "base": out_base,
+            "skips": state["skips"] + (1 - good),
+            "consecutive": jnp.where(
+                skip, state["consecutive"] + 1, 0
+            ).astype(jnp.int32),
+            "good_steps": state["good_steps"] + good,
+            "norm_ema": new_ema,
+            "bad_leaf": jnp.where(skip, bad_leaf_now, state["bad_leaf"]),
+        }
+        return out_params, new_state
+
+
+# -------------------------------------------------------------- placement
+
+
+def attach_sentinel(
+    optimizer: Optimizer,
+    divergent_axes: tuple[str, ...] = (),
+    **kwargs,
+) -> Optimizer:
+    """Insert a :class:`GradSentinel` at the correct point of a chain:
+    inside a :class:`ZeRO1` (guarding the post-reduce-scatter chunk
+    grads, with the data axis appended to ``divergent_axes`` since the
+    chunks are disjoint over it), outermost otherwise. ``kwargs`` pass
+    through to :class:`GradSentinel` (``skip_budget``, ``spike_factor``,
+    ...)."""
+    if isinstance(optimizer, ZeRO1):
+        sent = GradSentinel(
+            optimizer.base,
+            axis_names=tuple(divergent_axes) + (optimizer.axis_name,),
+            **kwargs,
+        )
+        return dataclasses.replace(optimizer, base=sent)
+    return GradSentinel(
+        optimizer, axis_names=tuple(divergent_axes), **kwargs
+    )
+
+
+def find_sentinel(optimizer: Optimizer) -> GradSentinel | None:
+    """The GradSentinel in an optimizer chain (walking ``.base`` links),
+    or None."""
+    opt = optimizer
+    while isinstance(opt, Optimizer):
+        if isinstance(opt, GradSentinel):
+            return opt
+        opt = getattr(opt, "base", None)
+    return None
+
+
+def find_sentinel_state(opt_state) -> dict | None:
+    """The sentinel's state dict inside a (possibly nested) optimizer
+    state tree, or None. Works on device trees and host snapshots."""
+    if isinstance(opt_state, dict):
+        if _STATE_KEYS <= set(opt_state):
+            return opt_state
+        for v in opt_state.values():
+            hit = find_sentinel_state(v)
+            if hit is not None:
+                return hit
+    elif isinstance(opt_state, (tuple, list)):
+        for v in opt_state:
+            hit = find_sentinel_state(v)
+            if hit is not None:
+                return hit
+    return None
+
+
+# ------------------------------------------------------------- host side
+
+
+def param_leaf_names(params: PyTree) -> list[str]:
+    """Leaf path strings in ``tree_flatten`` order — the order
+    ``bad_leaf`` indexes (ZeRO-1's flatten preserves tree structure, so
+    the order matches the original params)."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    return [jax.tree_util.keystr(path) for path, _ in flat]
+
+
+def sentinel_stats(opt_state) -> dict:
+    """One blocking fetch of the sentinel counters as python scalars."""
+    st = find_sentinel_state(opt_state)
+    if st is None:
+        raise ValueError("no GradSentinel state in this optimizer state")
+    return {
+        "skips": int(st["skips"]),
+        "consecutive": int(st["consecutive"]),
+        "good_steps": int(st["good_steps"]),
+        "norm_ema": float(st["norm_ema"]),
+        "bad_leaf": int(st["bad_leaf"]),
+    }
+
+
+def sentinel_hook(
+    sentinel: GradSentinel,
+    params_template: PyTree | None = None,
+    check_every: int = 1,
+):
+    """A ``train_loop`` hook escalating the consecutive-skip budget.
+
+    Every ``check_every`` steps it fetches the device-side counters (the
+    only host sync the sentinel ever causes — the hot loop itself is
+    sync-free) and raises :class:`SentinelTripped` once ``consecutive``
+    exceeds ``sentinel.skip_budget``, naming the first non-finite leaf
+    and, when the metrics carry accumulation taint, the microbatch
+    index that poisoned the sum.
+    """
+    names = (
+        param_leaf_names(params_template)
+        if params_template is not None
+        else None
+    )
+
+    def hook(*, step, train_state, metrics=None, **_):
+        if check_every > 1 and step % check_every:
+            return
+        st = find_sentinel_state(train_state.opt_state)
+        if st is None:
+            return
+        consecutive = int(st["consecutive"])
+        if consecutive <= sentinel.skip_budget:
+            return
+        leaf = int(st["bad_leaf"])
+        if names is not None and 0 <= leaf < len(names):
+            leaf_desc = f"leaf {leaf} ({names[leaf]})"
+        else:
+            leaf_desc = f"leaf {leaf}" if leaf >= 0 else "no non-finite leaf"
+        micro = ""
+        if metrics is not None and "bad_micro" in metrics:
+            idx = int(metrics["bad_micro"])
+            if idx >= 0:
+                micro = f", first poisoned microbatch {idx}"
+        raise SentinelTripped(
+            f"sentinel skipped {consecutive} consecutive steps "
+            f"(budget {sentinel.skip_budget}) at step {step}: first "
+            f"non-finite {leaf_desc}{micro}; total skips "
+            f"{int(st['skips'])}, norm_ema {float(st['norm_ema']):.3g}"
+        )
+
+    return hook

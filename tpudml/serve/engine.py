@@ -97,6 +97,24 @@ class ServeConfig:
     prefill_chunk: int = 32
     cache_kind: str = "f32"  # f32 | bf16 | int8 (serve.cache)
     eos_token: int | None = None  # early-stop token id (None: run budget out)
+    # Overload guard. ``max_queue`` bounds the waiting line: an arrival
+    # finding it full is REJECTED at admission control (event
+    # ``("reject", rid, -1, step)``) instead of growing an unbounded
+    # backlog whose tail latencies are all ruined together. ``deadline_s``
+    # is a per-request TTL from its arrival: a queued request strictly
+    # past its deadline is dropped before admission, an in-flight one is
+    # evicted at the next decode-step boundary (both logged as
+    # ``("expire", rid, slot, step)`` with slot=-1 for queued) — its
+    # ``finished`` stays None, so it never pollutes the latency
+    # percentiles of requests that met their contract.
+    max_queue: int | None = None  # None: unbounded (pre-guard behaviour)
+    deadline_s: float | None = None  # None: requests never expire
+    # Virtual clock: with ``step_time_s`` set, "now" is
+    # ``decode_steps × step_time_s`` (+ idle skips to the next arrival)
+    # instead of the wall clock, so queue depth, rejections, and expiries
+    # become a pure function of (workload seed, config) — the regime the
+    # overload tests pin bit-for-bit.
+    step_time_s: float | None = None
 
     def __post_init__(self):
         if self.slots < 1:
@@ -108,6 +126,12 @@ class ServeConfig:
                 f"prefill_chunk {self.prefill_chunk} must divide "
                 f"max_len {self.max_len} (padded tail chunks stay in-bounds)"
             )
+        if self.max_queue is not None and self.max_queue < 1:
+            raise ValueError("max_queue must be >= 1 (or None for unbounded)")
+        if self.deadline_s is not None and self.deadline_s <= 0:
+            raise ValueError("deadline_s must be > 0 (or None)")
+        if self.step_time_s is not None and self.step_time_s <= 0:
+            raise ValueError("step_time_s must be > 0 (or None)")
 
 
 @dataclass
@@ -122,6 +146,8 @@ class RequestStats:
     admitted: float | None = None  # prefill finished, slot occupied
     first_token: float | None = None
     finished: float | None = None
+    rejected: float | None = None  # bounced at admission control (full queue)
+    expired: float | None = None  # deadline passed (queued or mid-flight)
     slot: int | None = None
     tokens: list = field(default_factory=list)
     token_times: list = field(default_factory=list)
@@ -133,13 +159,22 @@ class ServeReport:
     (admit/evict tuples — the determinism contract), and aggregates."""
 
     requests: dict
-    events: list  # ("admit"|"evict", rid, slot, decode_step_index)
+    events: list  # ("admit"|"evict"|"reject"|"expire", rid, slot, step)
     decode_steps: int
     wall_time: float
+    peak_queue_depth: int = 0  # max waiting-line length ever observed
 
     @property
     def generated_tokens(self) -> int:
         return sum(len(s.tokens) for s in self.requests.values())
+
+    @property
+    def rejected(self) -> int:
+        return sum(1 for s in self.requests.values() if s.rejected is not None)
+
+    @property
+    def expired(self) -> int:
+        return sum(1 for s in self.requests.values() if s.expired is not None)
 
     @property
     def tokens_per_sec(self) -> float:
@@ -259,12 +294,17 @@ class ServingEngine:
     def run(self, requests: list[Request]) -> ServeReport:
         """Serve a request stream to completion. Arrival times are
         honored open-loop (a request only becomes admissible once the
-        wall clock passes its arrival), decode advances every occupied
-        slot one token per step, finished slots are refilled mid-flight
-        from the pending queue."""
+        clock passes its arrival), decode advances every occupied slot
+        one token per step, finished slots are refilled mid-flight from
+        the waiting queue. Every request ends in EXACTLY ONE terminal
+        state: finished, rejected (bounded queue full at arrival), or
+        expired (deadline passed while queued or in flight) — the
+        ledger-accounting invariant the overload tests audit.
+        """
         cfg = self.cfg
         b = cfg.slots
-        pending = deque(sorted(requests, key=lambda r: (r.arrival_time, r.rid)))
+        arrivals = deque(sorted(requests, key=lambda r: (r.arrival_time, r.rid)))
+        queue: deque[Request] = deque()  # arrived, not yet admitted
         stats = {
             r.rid: RequestStats(
                 rid=r.rid, prompt_len=len(r.prompt),
@@ -279,30 +319,70 @@ class ServingEngine:
         pos = np.zeros(b, np.int32)
         remaining = np.zeros(b, np.int64)
         slot_rid = np.full(b, -1, np.int64)
+        slot_deadline = np.full(b, np.inf)
         active = np.zeros(b, bool)
         events: list = []
         steps = 0
+        peak_queue = 0
+        # Clock: wall time by default; virtual (decode-step-derived) when
+        # cfg.step_time_s is set — see ServeConfig.
         t0 = time.perf_counter()
-        now = lambda: time.perf_counter() - t0  # noqa: E731
+        v_extra = 0.0  # virtual-clock idle skips (accumulated)
+        if cfg.step_time_s is not None:
+            now = lambda: steps * cfg.step_time_s + v_extra  # noqa: E731
+        else:
+            now = lambda: time.perf_counter() - t0  # noqa: E731
 
-        while pending or active.any():
+        while arrivals or queue or active.any():
+            t = now()
+            # Stage arrivals into the waiting queue; a full bounded queue
+            # rejects at the door (slot -1 in the event tuple).
+            while arrivals and arrivals[0].arrival_time <= t:
+                req = arrivals.popleft()
+                if cfg.max_queue is not None and len(queue) >= cfg.max_queue:
+                    stats[req.rid].rejected = t
+                    events.append(("reject", req.rid, -1, steps))
+                else:
+                    queue.append(req)
+            peak_queue = max(peak_queue, len(queue))
+            # Expire queued requests strictly past arrival + deadline
+            # BEFORE admission — never spend prefill on a dead request.
+            if cfg.deadline_s is not None:
+                kept: deque[Request] = deque()
+                while queue:
+                    req = queue.popleft()
+                    if t > req.arrival_time + cfg.deadline_s:
+                        stats[req.rid].expired = t
+                        events.append(("expire", req.rid, -1, steps))
+                    else:
+                        kept.append(req)
+                queue = kept
             # Admit: free slots in index order, queue in arrival order.
             for i in range(b):
-                if active[i] or not pending or pending[0].arrival_time > now():
+                if active[i] or not queue:
                     continue
-                req = pending.popleft()
+                req = queue.popleft()
                 pos[i], last[i] = self._admit(i, req)
                 remaining[i] = req.max_new_tokens
                 slot_rid[i] = req.rid
+                slot_deadline[i] = (
+                    req.arrival_time + cfg.deadline_s
+                    if cfg.deadline_s is not None
+                    else np.inf
+                )
                 active[i] = True
                 st = stats[req.rid]
                 st.admitted = now()
                 st.slot = i
                 events.append(("admit", req.rid, i, steps))
             if not active.any():
+                if not arrivals:
+                    continue  # queue drained by expiry; loop re-checks
                 # Idle: nothing in flight, queue head hasn't arrived yet.
-                gap = pending[0].arrival_time - now()
-                if gap > 0:
+                gap = arrivals[0].arrival_time - now()
+                if cfg.step_time_s is not None:
+                    v_extra += max(gap, 0.0)  # skip virtual time forward
+                elif gap > 0:
                     time.sleep(min(gap, 0.05))
                 continue
             # One decode step for ALL slots. Inactive slots run garbage
@@ -334,7 +414,15 @@ class ServingEngine:
                     active[i] = False
                     events.append(("evict", int(slot_rid[i]), i, steps))
                     slot_rid[i] = -1
+                elif t_step > slot_deadline[i]:
+                    # Mid-flight deadline eviction at the step boundary:
+                    # the slot frees for the queue head, the partial
+                    # tokens stay in the ledger, finished stays None.
+                    st.expired = t_step
+                    active[i] = False
+                    events.append(("expire", int(slot_rid[i]), i, steps))
+                    slot_rid[i] = -1
         return ServeReport(
             requests=stats, events=events, decode_steps=steps,
-            wall_time=now(),
+            wall_time=now(), peak_queue_depth=peak_queue,
         )

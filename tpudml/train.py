@@ -102,6 +102,14 @@ def make_loss_fn(
     return loss_fn
 
 
+def _grads_nonfinite(grads) -> jax.Array:
+    """Scalar bool: any non-finite element in any grad leaf."""
+    leaves = jax.tree_util.tree_leaves(grads)
+    return jnp.any(
+        jnp.stack([jnp.any(~jnp.isfinite(g)) for g in leaves])
+    )
+
+
 def accumulate_grads(
     loss_fn: Callable,
     params: Any,
@@ -110,6 +118,7 @@ def accumulate_grads(
     labels: jax.Array,
     rng: jax.Array | None,
     accum_steps: int,
+    taint: bool = False,
 ):
     """Gradients of ``loss_fn`` over the batch, computed in ``accum_steps``
     sequential micro-batches inside one XLA program (``lax.scan``) —
@@ -118,6 +127,13 @@ def accumulate_grads(
     and metrics are micro-batch means, model_state threads through the
     chunks (e.g. BN running stats see every micro-batch).
 
+    ``taint=True`` adds ``metrics["bad_micro"]``: the index of the FIRST
+    micro-batch whose gradients contain a non-finite value (-1 if none).
+    A single poisoned micro-batch makes the accumulated sum non-finite —
+    the sentinel then skips the whole step — and the taint pinpoints the
+    culprit for the escalation diagnostic instead of letting it average
+    in silently.
+
     ``accum_steps=1`` short-circuits to a single grad call.
     """
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
@@ -125,7 +141,12 @@ def accumulate_grads(
         (loss, (model_state, logits)), grads = grad_fn(
             params, model_state, images, labels, rng
         )
-        return grads, model_state, {"loss": loss, "accuracy": accuracy(logits, labels)}
+        metrics = {"loss": loss, "accuracy": accuracy(logits, labels)}
+        if taint:
+            metrics["bad_micro"] = jnp.where(
+                _grads_nonfinite(grads), 0, -1
+            ).astype(jnp.int32)
+        return grads, model_state, metrics
 
     batch = images.shape[0]
     if batch % accum_steps:
@@ -140,26 +161,37 @@ def accumulate_grads(
     zero_grads = jax.tree.map(jnp.zeros_like, params)
 
     def body(carry, mb):
-        grads_acc, state, loss_acc, acc_acc = carry
+        grads_acc, state, loss_acc, acc_acc, bad_acc = carry
         imgs, lbls, i = mb
         mb_rng = None if rng is None else jax.random.fold_in(rng, i)
         (loss, (state, logits)), grads = grad_fn(params, state, imgs, lbls, mb_rng)
         grads_acc = jax.tree.map(jnp.add, grads_acc, grads)
+        if taint:
+            bad_acc = jnp.where(
+                (bad_acc < 0) & _grads_nonfinite(grads),
+                i.astype(jnp.int32),
+                bad_acc,
+            )
         return (
             grads_acc,
             state,
             loss_acc + loss,
             acc_acc + accuracy(logits, lbls),
+            bad_acc,
         ), None
 
-    (grads_sum, model_state, loss_sum, acc_sum), _ = jax.lax.scan(
+    (grads_sum, model_state, loss_sum, acc_sum, bad_micro), _ = jax.lax.scan(
         body,
-        (zero_grads, model_state, jnp.zeros(()), jnp.zeros(())),
+        (zero_grads, model_state, jnp.zeros(()), jnp.zeros(()),
+         jnp.full((), -1, jnp.int32)),
         (mb_images, mb_labels, jnp.arange(accum_steps)),
     )
     inv = 1.0 / accum_steps
     grads = jax.tree.map(lambda g: g * inv, grads_sum)
-    return grads, model_state, {"loss": loss_sum * inv, "accuracy": acc_sum * inv}
+    metrics = {"loss": loss_sum * inv, "accuracy": acc_sum * inv}
+    if taint:
+        metrics["bad_micro"] = bad_micro
+    return grads, model_state, metrics
 
 
 def accumulate_fused_grads(
@@ -170,16 +202,23 @@ def accumulate_fused_grads(
     labels: jax.Array,
     rng: jax.Array | None,
     accum_steps: int,
+    taint: bool = False,
 ):
     """:func:`accumulate_grads` for FUSED loss fns — those returning
     ``(loss, new_model_state)`` with no logits aux (the linear-cross-
     entropy head never materializes them), so metrics carry loss only.
-    Same micro-batch scan, same per-chunk rng fold, same mean semantics:
-    the full-batch gradient at micro-batch activation memory."""
+    Same micro-batch scan, same per-chunk rng fold, same mean semantics
+    (and the same ``taint`` micro-batch tracking): the full-batch
+    gradient at micro-batch activation memory."""
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
     if accum_steps == 1:
         (loss, model_state), grads = grad_fn(params, model_state, tokens, labels, rng)
-        return grads, model_state, {"loss": loss}
+        metrics = {"loss": loss}
+        if taint:
+            metrics["bad_micro"] = jnp.where(
+                _grads_nonfinite(grads), 0, -1
+            ).astype(jnp.int32)
+        return grads, model_state, metrics
 
     batch = tokens.shape[0]
     if batch % accum_steps:
@@ -194,21 +233,30 @@ def accumulate_fused_grads(
     zero_grads = jax.tree.map(jnp.zeros_like, params)
 
     def body(carry, mb):
-        grads_acc, state, loss_acc = carry
+        grads_acc, state, loss_acc, bad_acc = carry
         toks, lbls, i = mb
         mb_rng = None if rng is None else jax.random.fold_in(rng, i)
         (loss, state), grads = grad_fn(params, state, toks, lbls, mb_rng)
         grads_acc = jax.tree.map(jnp.add, grads_acc, grads)
-        return (grads_acc, state, loss_acc + loss), None
+        if taint:
+            bad_acc = jnp.where(
+                (bad_acc < 0) & _grads_nonfinite(grads),
+                i.astype(jnp.int32),
+                bad_acc,
+            )
+        return (grads_acc, state, loss_acc + loss, bad_acc), None
 
-    (grads_sum, model_state, loss_sum), _ = jax.lax.scan(
+    (grads_sum, model_state, loss_sum, bad_micro), _ = jax.lax.scan(
         body,
-        (zero_grads, model_state, jnp.zeros(())),
+        (zero_grads, model_state, jnp.zeros(()), jnp.full((), -1, jnp.int32)),
         (mb_tokens, mb_labels, jnp.arange(accum_steps)),
     )
     inv = 1.0 / accum_steps
     grads = jax.tree.map(lambda g: g * inv, grads_sum)
-    return grads, model_state, {"loss": loss_sum * inv}
+    metrics = {"loss": loss_sum * inv}
+    if taint:
+        metrics["bad_micro"] = bad_micro
+    return grads, model_state, metrics
 
 
 def make_train_step_body(
@@ -526,20 +574,30 @@ def train_loop(
         accum_steps=accum_steps,
     )
     # Resume semantics: ``num_epochs`` is the TOTAL budget. A restored
-    # state (step > 0) skips the epochs already completed — same sampler
-    # epochs, same step-derived dropout streams — so a preempted+resumed
-    # run finishes the configured budget instead of re-training it.
-    # Granularity is whole epochs: a partially-trained epoch is redone
-    # from its start. (One host sync here, before the loop — not per step.)
+    # state (step > 0) resumes STEP-GRANULAR: completed epochs are
+    # skipped outright, and within the partial epoch the first
+    # ``start_step % steps_per_epoch`` batches are fast-forwarded —
+    # ``set_epoch`` regenerates the same (seed, epoch) sampler
+    # permutation and dropout streams fold ``rng_root`` by ``ts.step``
+    # inside the program, so the resumed run replays exactly the batches
+    # and rng the uninterrupted run would have seen from that step on
+    # (bit-exact params; see docs/RESILIENCE.md). (One host sync here,
+    # before the loop — not per step.)
     counter = start_step = int(ts.step)
     steps_per_epoch = len(train_loader) if hasattr(train_loader, "__len__") else 0
-    start_epoch = min(start_step // steps_per_epoch, num_epochs) if steps_per_epoch else 0
+    if steps_per_epoch:
+        start_epoch = min(start_step // steps_per_epoch, num_epochs)
+        skip_batches = start_step - start_epoch * steps_per_epoch
+    else:
+        start_epoch, skip_batches = 0, 0
     t0 = time.time()
     metrics = None  # device values; materialized to floats only on log/exit
     for epoch in range(start_epoch, num_epochs):
         if hasattr(train_loader, "set_epoch"):
             train_loader.set_epoch(epoch)
-        for images, labels in train_loader:
+        for i, (images, labels) in enumerate(train_loader):
+            if epoch == start_epoch and i < skip_batches:
+                continue  # fast-forward the sampler to the resume point
             ts, metrics = step(ts, images, labels)
             counter += 1
             if log_every and counter % log_every == 0:
