@@ -151,7 +151,32 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--log_every", type=int, default=20)
     p.add_argument("--log_dir", type=str, default="./logs")
     p.add_argument("--seed", type=int, default=0)
-    return p.parse_args(argv)
+    p.add_argument(
+        "--sentinel", action="store_true",
+        help="in-graph step sentinel (tpudml.resilience): skip non-finite "
+        "updates on-device and escalate past the consecutive-skip budget "
+        "with a leaf-naming diagnostic; composes with dp/fsdp/tp/pp "
+        "(cp/ep engines don't carry a sentinel yet)",
+    )
+    p.add_argument(
+        "--ckpt_dir", type=str, default=None,
+        help="checkpoint directory (enables --ckpt_every/--resume)",
+    )
+    p.add_argument(
+        "--ckpt_every", type=int, default=0,
+        help="save a rolling checkpoint every N optimizer steps "
+        "(keyed by the TrainState's monotonic step counter)",
+    )
+    p.add_argument(
+        "--resume", action="store_true",
+        help="restore the latest VALID checkpoint from --ckpt_dir and "
+        "continue to --steps (step-granular: a run killed at step k "
+        "restarts from the last verified save, not from scratch)",
+    )
+    args = p.parse_args(argv)
+    if (args.resume or args.ckpt_every) and not args.ckpt_dir:
+        p.error("--resume/--ckpt_every need --ckpt_dir")
+    return args
 
 
 def build_engine(args, devices):
@@ -183,6 +208,16 @@ def build_engine(args, devices):
         )
     # Tristate: force-on / force-lean / None = auto by residual size.
     args._save_scores = True if scores else (False if lean else None)
+    sentinel = getattr(args, "sentinel", False)
+    args._sentinel = None  # engine's GradSentinel, for the escalation hook
+    if sentinel and args.parallel not in ("dp", "fsdp", "tp", "pp"):
+        # single's make_train_step and the cp/ep engines have no sentinel
+        # slot in their optimizer chain; silently dropping the flag would
+        # fake resilience coverage.
+        raise ValueError(
+            f"--sentinel composes with --parallel dp/fsdp/tp/pp, not "
+            f"{args.parallel!r}"
+        )
     base = dict(
         vocab_size=args.vocab,
         embed_dim=args.embed_dim,
@@ -254,7 +289,9 @@ def build_engine(args, devices):
         engine = DataParallel(
             model, opt, mesh, rng_root=rng_root, stacked_batches=False,
             fused_xent=args.fused_xent, save_scores=args._save_scores,
+            sentinel=sentinel,
         )
+        args._sentinel = engine.sentinel
         return engine.create_state(seed_key(args.seed)), engine.make_train_step()
     if args.parallel == "fsdp":
         # ZeRO-3: params/grads/opt-state sharded over the data axis too.
@@ -264,7 +301,9 @@ def build_engine(args, devices):
         engine = FSDP(
             model, opt, mesh, rng_root=rng_root,
             fused_xent=args.fused_xent, save_scores=args._save_scores,
+            sentinel=sentinel,
         )
+        args._sentinel = engine.sentinel
         return engine.create_state(seed_key(args.seed)), engine.make_train_step()
     if args.parallel == "pp":
         # One decoder block per pipeline stage; embed/head replicated.
@@ -301,6 +340,7 @@ def build_engine(args, devices):
             ),
             epilogue=TransformerHead(args.embed_dim, args.vocab),
             batch_axis="data" if d > 1 else None,
+            sentinel=sentinel,
         )
         block = TransformerBlock(
             args.embed_dim, args.num_heads, causal=True, impl=impl,
@@ -317,6 +357,7 @@ def build_engine(args, devices):
             pipe = OneFOneB(block, rng_root=rng_root, **common)
         else:
             pipe = GPipe(block, **common)
+        args._sentinel = pipe.sentinel
         return pipe.create_state(seed_key(args.seed)), pipe.make_train_step()
     # tp
     mesh = make_mesh(MeshConfig({"model": n}), devices)
@@ -324,7 +365,9 @@ def build_engine(args, devices):
         model, opt, mesh, rule=tensor_parallel_rules("model"),
         axis_name="model", rng_root=rng_root,
         fused_xent=args.fused_xent, save_scores=args._save_scores,
+        sentinel=sentinel,
     )
+    args._sentinel = engine.sentinel
     return engine.create_state(seed_key(args.seed)), engine.make_train_step()
 
 
@@ -334,7 +377,8 @@ def run(args) -> dict:
     distributed_init()
     # Same-program guard (SURVEY.md §5.2): all ranks must agree on argv
     # (minus host-local paths, which may be rank-templated).
-    rank_invariant = {k: v for k, v in vars(args).items() if k != "log_dir"}
+    rank_invariant = {k: v for k, v in vars(args).items()
+                      if k not in ("log_dir", "ckpt_dir")}
     assert_same_program(repr(sorted(rank_invariant.items())), "task5 args")
     devices = jax.devices()
     if args.n_devices and args.parallel != "single":
@@ -345,6 +389,32 @@ def run(args) -> dict:
     seqs = synthetic_lm(args.batch_size * 4, args.seq_len, args.vocab, seed=args.seed)
     ts, step = build_engine(args, devices)
 
+    mgr = None
+    start = 0
+    if args.ckpt_dir:
+        from tpudml.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(args.ckpt_dir)
+        if args.resume:
+            # Latest VALID checkpoint: restores verify per-leaf checksums
+            # and walk past corrupt/partial step dirs (docs/RESILIENCE.md).
+            ts = mgr.restore_latest(ts)
+            start = int(ts.step)
+            if start >= args.steps:
+                raise ValueError(
+                    f"--resume: latest checkpoint is already at step "
+                    f"{start} >= --steps {args.steps}; nothing left to run"
+                )
+            if start:
+                print(f"resumed from step {start} ({args.ckpt_dir})")
+    guard = None
+    if args._sentinel is not None:
+        # Escalate past the consecutive-skip budget with a diagnostic
+        # naming the poisoned leaf (same hook task2 installs).
+        from tpudml.resilience import sentinel_hook
+
+        guard = sentinel_hook(args._sentinel, ts.params)
+
     writer = MetricsWriter(args.log_dir, run_name=f"task5-{args.parallel}")
     rng = np.random.default_rng(args.seed)
     t0 = None
@@ -352,15 +422,23 @@ def run(args) -> dict:
     hit_target = None
     time_to_target = None
     final_step = args.steps
-    steady_from = 1  # may break out before the steady-state marker step
-    for i in range(1, args.steps + 1):
+    steady_from = start + 1  # may break out before the steady-state marker
+    # Steady state: past the compile on the first step of THIS run, capped
+    # at 5 so even a run that hits its target at the earliest check
+    # (step 10) still has a throughput window.
+    steady_mark = start + min(max((args.steps - start) // 5, 1), 5)
+    for i in range(start + 1, args.steps + 1):
+        # The loop counter IS the global step: resume starts past the
+        # restored ts.step, so the data stream, checkpoint keys, and
+        # logging all continue where the killed run stopped.
         rows = rng.integers(0, len(seqs), size=args.batch_size)
         batch = seqs[rows]
         ts, metrics = step(ts, batch[:, :-1], batch[:, 1:])
-        # Steady state: past the compile on step 1, capped at 5 so even a
-        # run that hits its target at the earliest check (step 10) still
-        # has a throughput window.
-        if i == min(max(args.steps // 5, 1), 5):
+        if guard is not None:
+            guard(step=i, train_state=ts, metrics=metrics)
+        if mgr is not None and args.ckpt_every and i % args.ckpt_every == 0:
+            mgr.save(ts, i, metadata={"parallel": args.parallel})
+        if i == steady_mark:
             jax.block_until_ready(metrics["loss"])
             t0, steady_from = time.time(), i
         logged = args.log_every and i % args.log_every == 0
@@ -390,6 +468,10 @@ def run(args) -> dict:
                 )
                 break
     jax.block_until_ready(ts.params)
+    if mgr is not None:
+        from tasks.common import final_checkpoint
+
+        final_checkpoint(mgr, ts)
     loss = float(metrics["loss"])
     elapsed = time.time() - t0 if t0 else float("nan")
     tokens = (final_step - steady_from) * args.batch_size * args.seq_len

@@ -1,0 +1,11 @@
+"""Jaxpr-pass fixture modules, discovered by filename.
+
+Each module in this directory is one seeded program for the dataflow
+rules (J112–J116): ``RULE`` names the rule under test, ``EXPECT`` is
+``"fire"`` or ``"silent"``, ``build()`` returns ``(fn, args)`` for
+``analyze_callable``, and optional ``ANALYZE_KWARGS`` forwards extra
+analyzer arguments (e.g. ``hbm_budget_bytes`` to arm J116).
+test_analysis.py parametrizes over the directory listing, so a fixture
+that fails to import/build/trace reports ITS OWN filename instead of an
+opaque parametrize error — add a module, get a test.
+"""

@@ -1,0 +1,25 @@
+"""J112 silent twin: the same per-shard partial, but a ``pmean`` merges
+it over the data axis before the replicated output — the value really
+is identical across shards, so the lattice proves it replicated."""
+
+RULE = "J112"
+EXPECT = "silent"
+
+
+def build():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from tpudml.core.config import MeshConfig
+    from tpudml.core.dist import make_mesh
+    from tpudml.parallel.sharding import shard_map_fn
+
+    mesh = make_mesh(MeshConfig({"data": 2}), jax.devices()[:2])
+
+    def body(xs):
+        return jax.lax.pmean(jnp.mean(xs), "data")
+
+    fn = jax.jit(shard_map_fn(body, mesh, in_specs=(P("data"),),
+                              out_specs=P()))
+    return fn, (jnp.ones((8, 4)),)

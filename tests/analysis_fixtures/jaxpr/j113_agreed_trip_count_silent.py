@@ -1,0 +1,34 @@
+"""J113 silent twin: the predicate derives from a pmax-reduced local
+condition, so every shard agrees on the trip count and the body psum is
+balanced across ranks — the fix the rule's hint prescribes."""
+
+RULE = "J113"
+EXPECT = "silent"
+
+
+def build():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from tpudml.core.config import MeshConfig
+    from tpudml.core.dist import make_mesh
+    from tpudml.parallel.sharding import shard_map_fn
+
+    mesh = make_mesh(MeshConfig({"data": 2}), jax.devices()[:2])
+
+    def body(xs):
+        # Shard-local stopping signal, reduced so all shards agree.
+        limit = jax.lax.pmax(xs.max(), "data")
+
+        def cond(c):
+            return c[0] < limit
+
+        def step(c):
+            return (c[0] + 1.0, jax.lax.psum(c[1], "data"))
+
+        return jax.lax.while_loop(cond, step, (jnp.float32(0), xs.sum()))[1]
+
+    fn = jax.jit(shard_map_fn(body, mesh, in_specs=(P("data"),),
+                              out_specs=P()))
+    return fn, (jnp.ones((8,)),)

@@ -1,0 +1,34 @@
+"""J113 firing: a while loop whose trip count depends on
+``axis_index`` — each shard iterates a different number of times — with
+a psum inside the body. Shards that exit early never post the
+collective their peers are blocked in: the slice deadlocks."""
+
+RULE = "J113"
+EXPECT = "fire"
+
+
+def build():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from tpudml.core.config import MeshConfig
+    from tpudml.core.dist import make_mesh
+    from tpudml.parallel.sharding import shard_map_fn
+
+    mesh = make_mesh(MeshConfig({"data": 2}), jax.devices()[:2])
+
+    def body(xs):
+        limit = jax.lax.axis_index("data").astype(jnp.float32)
+
+        def cond(c):
+            return c[0] < limit  # per-shard trip count
+
+        def step(c):
+            return (c[0] + 1.0, jax.lax.psum(c[1], "data"))
+
+        return jax.lax.while_loop(cond, step, (jnp.float32(0), xs.sum()))[1]
+
+    fn = jax.jit(shard_map_fn(body, mesh, in_specs=(P("data"),),
+                              out_specs=P()))
+    return fn, (jnp.ones((8,)),)

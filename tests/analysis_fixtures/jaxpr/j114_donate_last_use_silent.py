@@ -1,0 +1,19 @@
+"""J114 silent twin: the donated argument's last use IS the donating
+call — the caller only touches the returned buffer afterwards, which is
+exactly the in-place update pattern donation exists for."""
+
+RULE = "J114"
+EXPECT = "silent"
+
+
+def build():
+    import jax
+    import jax.numpy as jnp
+
+    update = jax.jit(lambda s: s + 1.0, donate_argnums=(0,))
+
+    def fn(s):
+        new = update(s)
+        return new * 2.0
+
+    return fn, (jnp.ones((16,)),)

@@ -1,0 +1,19 @@
+"""J114 firing: an inner jitted update donates its argument buffer,
+and the caller then reads the donated value again — on TPU the second
+read observes whatever the donated-out allocation was reused for."""
+
+RULE = "J114"
+EXPECT = "fire"
+
+
+def build():
+    import jax
+    import jax.numpy as jnp
+
+    update = jax.jit(lambda s: s + 1.0, donate_argnums=(0,))
+
+    def fn(s):
+        new = update(s)
+        return new + s  # reads s after its buffer was donated
+
+    return fn, (jnp.ones((16,)),)

@@ -1,0 +1,28 @@
+"""J115 firing: an all-gather-shaped psum whose full result is consumed
+only through per-shard dynamic slices (index = axis_index) — every
+device pays for the whole allreduce but keeps 1/N of it. psum_scatter
+(reduce_scatter) moves (N-1)/N fewer wire bytes for the same answer."""
+
+RULE = "J115"
+EXPECT = "fire"
+
+
+def build():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from tpudml.core.config import MeshConfig
+    from tpudml.core.dist import make_mesh
+    from tpudml.parallel.sharding import shard_map_fn
+
+    mesh = make_mesh(MeshConfig({"data": 2}), jax.devices()[:2])
+
+    def body(xs):
+        full = jax.lax.psum(xs, "data")  # everyone gets all 8 elements
+        i = jax.lax.axis_index("data")
+        return jax.lax.dynamic_slice(full, (i * 4,), (4,))  # keeps 1/N
+
+    fn = jax.jit(shard_map_fn(body, mesh, in_specs=(P(),),
+                              out_specs=P("data")))
+    return fn, (jnp.ones((8,)),)

@@ -1,0 +1,25 @@
+"""J115 silent twin: the same reduce-then-keep-your-shard dataflow
+expressed directly as psum_scatter — each device receives only its
+shard, so there is no oversized allreduce to flag."""
+
+RULE = "J115"
+EXPECT = "silent"
+
+
+def build():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from tpudml.core.config import MeshConfig
+    from tpudml.core.dist import make_mesh
+    from tpudml.parallel.sharding import shard_map_fn
+
+    mesh = make_mesh(MeshConfig({"data": 2}), jax.devices()[:2])
+
+    def body(xs):
+        return jax.lax.psum_scatter(xs, "data", tiled=True)
+
+    fn = jax.jit(shard_map_fn(body, mesh, in_specs=(P(),),
+                              out_specs=P("data")))
+    return fn, (jnp.ones((8,)),)

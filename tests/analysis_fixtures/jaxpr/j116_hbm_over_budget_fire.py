@@ -1,0 +1,17 @@
+"""J116 firing: the program materialises a 1 MB f32 intermediate while
+``hbm_budget_bytes`` arms the checker at 64 KB — the static peak-live
+walk must report the budget breach before any compile happens."""
+
+RULE = "J116"
+EXPECT = "fire"
+ANALYZE_KWARGS = {"hbm_budget_bytes": 64 * 1024}
+
+
+def build():
+    import jax.numpy as jnp
+
+    def fn(x):
+        big = jnp.outer(x, x)  # 512*512*4 = 1 MB live
+        return big.sum()
+
+    return fn, (jnp.ones((512,)),)

@@ -1,0 +1,16 @@
+"""J116 silent twin: same program, but the armed budget (16 MB) has
+headroom over the ~1 MB static peak — no finding."""
+
+RULE = "J116"
+EXPECT = "silent"
+ANALYZE_KWARGS = {"hbm_budget_bytes": 16 * 1024 * 1024}
+
+
+def build():
+    import jax.numpy as jnp
+
+    def fn(x):
+        big = jnp.outer(x, x)
+        return big.sum()
+
+    return fn, (jnp.ones((512,)),)

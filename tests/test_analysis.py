@@ -391,6 +391,8 @@ def test_strict_cli_green_on_repo():
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "0 finding(s)" in proc.stdout
+    # Full-surface strict run: no committed suppression may be stale.
+    assert "stale allowlist" not in proc.stdout
 
 
 def test_j111_unguarded_update_fires_and_sentinel_is_silent():
@@ -422,3 +424,205 @@ def test_j111_allowlist_covers_plain_engines():
     active, allowed = split_allowed(findings, entries)
     assert [f for f in active if f.rule == "J111"] == []
     assert any(f.rule == "J111" for f in allowed)
+
+
+# ----------------------------------- dataflow rules (J112-J116) fixtures
+
+
+JAXPR_FIXDIR = os.path.join(FIXTURES, "jaxpr")
+
+
+def _jaxpr_fixture_names():
+    return sorted(f[:-3] for f in os.listdir(JAXPR_FIXDIR)
+                  if f.endswith(".py") and f != "__init__.py")
+
+
+@pytest.mark.parametrize("name", _jaxpr_fixture_names())
+def test_dataflow_fixture(name):
+    """One test per module in analysis_fixtures/jaxpr/ — discovery is by
+    filename, so a fixture that fails to import or build fails THIS test
+    under its own name instead of aborting collection with an opaque
+    parametrize error. Protocol: see that directory's __init__.py."""
+    import importlib.util
+
+    path = os.path.join(JAXPR_FIXDIR, name + ".py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    try:
+        spec.loader.exec_module(mod)
+    except Exception as e:  # noqa: BLE001 - reported with the fixture name
+        pytest.fail(f"fixture {name}: import failed: {e!r}")
+    missing = [a for a in ("RULE", "EXPECT", "build") if not hasattr(mod, a)]
+    if missing:
+        pytest.fail(f"fixture {name}: missing {missing} "
+                    "(protocol in analysis_fixtures/jaxpr/__init__.py)")
+    try:
+        fn, fargs = mod.build()
+    except Exception as e:  # noqa: BLE001 - reported with the fixture name
+        pytest.fail(f"fixture {name}: build() failed: {e!r}")
+
+    findings = analyze_callable(
+        fn, fargs, entrypoint=name, **getattr(mod, "ANALYZE_KWARGS", {}))
+    fired = [f for f in findings if f.rule == mod.RULE]
+    if mod.EXPECT == "fire":
+        assert fired, (name, findings)
+        assert all(f.hint for f in fired)
+    else:
+        assert fired == [], (name, fired)
+
+
+def test_jaxpr_fixture_dir_covers_every_dataflow_rule():
+    """Each dataflow rule ships a firing seeded-bug fixture AND a silent
+    correct-code twin; a deleted fixture file fails here by rule name."""
+    names = _jaxpr_fixture_names()
+    for rule in ("j112", "j113", "j114", "j115", "j116"):
+        kinds = {n.rsplit("_", 1)[1] for n in names if n.startswith(rule)}
+        assert kinds == {"fire", "silent"}, (rule, kinds)
+
+
+# ------------------------------------------- dataflow lattice fixpoint
+
+
+@pytest.mark.parametrize("name", ["serve_decode", "dp_sentinel"])
+def test_dataflow_converges_on_looping_entrypoints(name):
+    """The lattice fixpoint must settle within its iteration cap on the
+    entrypoints with the most control flow: the serving decode step
+    (scan + caches) and the sentinel ZeRO-1 step (is_finite cond around
+    the sharded update)."""
+    from tpudml.analysis.dataflow import _MAX_FIXPOINT_ITERS, analyze_dataflow
+    from tpudml.analysis.entrypoints import ENTRYPOINTS
+
+    prog = ENTRYPOINTS[name]()[0]
+    closed = jax.make_jaxpr(prog.fn)(*prog.args)
+    flow = analyze_dataflow(closed, name, in_specs=prog.in_specs,
+                            mesh_axes=prog.mesh_axes)
+    assert flow.converged, flow
+    assert flow.iterations < _MAX_FIXPOINT_ITERS
+    assert not [f for f in flow.findings if f.severity == "error"], flow
+
+
+# --------------------------- static cost vs measured CommStats (5% pin)
+
+
+@pytest.mark.parametrize("zero1", [False, True], ids=["dp", "zero1"])
+def test_static_cost_matches_measured_comm_bytes(zero1):
+    """Acceptance pin: the --cost byte counts for the DP and ZeRO-1
+    steps agree with the measured-path CommStats accounting within 5%
+    on a world-4 mesh. Both sides price the same ring model
+    (comm.timing.collective_wire_bytes), so this checks the static
+    interpreter's event inventory — collective kinds, payload bytes,
+    trip counts — against the program the engine actually times."""
+    from tpudml.analysis.dataflow import analyze_dataflow
+    from tpudml.core.config import MeshConfig
+    from tpudml.core.dist import make_mesh
+    from tpudml.core.prng import seed_key
+    from tpudml.models import LeNet
+    from tpudml.optim import make_optimizer
+    from tpudml.parallel.dp import DataParallel
+
+    mesh = make_mesh(MeshConfig({"data": 4}), jax.devices()[:4])
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(8, 28, 28, 1)).astype(np.float32)
+    y = rng.integers(0, 10, size=(8,)).astype(np.int32)
+    opt = "adam" if zero1 else "sgd"
+
+    measured_dp = DataParallel(
+        LeNet(), make_optimizer(opt, 0.01), mesh,
+        measure_comm=True, zero1=zero1)
+    ts = measured_dp.create_state(seed_key(0))
+    measured_dp.make_train_step()(ts, x, y)
+    measured = measured_dp.comm_stats.comm_bytes
+    assert measured > 0.0
+
+    static_dp = DataParallel(
+        LeNet(), make_optimizer(opt, 0.01), mesh, zero1=zero1)
+    ts2 = static_dp.create_state(seed_key(0))
+    fused = static_dp.make_train_step()
+    closed = jax.make_jaxpr(fused.jitted)(ts2, x, y)
+    flow = analyze_dataflow(closed, f"xval-{opt}", in_specs=fused.in_specs,
+                            mesh_axes=fused.mesh_axes)
+    static = sum(ev.wire_bytes * ev.trips for ev in flow.comm_events)
+    assert abs(static - measured) / measured <= 0.05, (static, measured)
+
+
+# --------------------------------------------- stale allowlist entries
+
+
+def test_stale_allowlist_entries_detected():
+    """unused_entries flags suppressions whose finding no longer exists
+    (and only those), so --strict can warn before an allowlist entry
+    silently outlives its bug."""
+    from tpudml.analysis.allowlist import AllowEntry, unused_entries
+    from tpudml.analysis.findings import Finding
+
+    live = AllowEntry(rule="J111", path="tpudml/optim/*",
+                      reason="plain engines omit the sentinel by design")
+    live_line = AllowEntry(rule="A201", path="tools/*.py", line=12,
+                           reason="host-side CLI glue")
+    stale = AllowEntry(rule="J105", path="tpudml/nn/old_layer.py",
+                       reason="fixed in the ragged-dW rework")
+    wrong_line = AllowEntry(rule="A201", path="tools/*.py", line=99,
+                            reason="drifted line anchor")
+    findings = [
+        Finding("J111", "no finiteness gate",
+                file="tpudml/optim/optimizers.py", line=40),
+        Finding("A201", "python if on traced value",
+                file="tools/bench.py", line=12),
+    ]
+    entries = [live, live_line, stale, wrong_line]
+    assert unused_entries(findings, entries) == [stale, wrong_line]
+    assert unused_entries(findings, [live, live_line]) == []
+
+
+# ------------------------------------------------ CLI output formats
+
+
+def _run_cli(*cli_args, timeout=180):
+    return subprocess.run(
+        [sys.executable, "-m", "tpudml.analysis", *cli_args],
+        cwd=REPO, capture_output=True, text=True, timeout=timeout,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+
+
+def test_cli_format_json_golden():
+    """--format json emits one machine-readable object with the three
+    fixed keys; every finding carries rule/severity/location. Scoped to
+    the seeded AST fixture (fast, deterministic — no tracing)."""
+    import json
+
+    proc = _run_cli(
+        "--skip-jaxpr", "--format", "json", "--paths",
+        os.path.join("tests", "analysis_fixtures", "seeded_violations.py"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = json.loads(proc.stdout)
+    assert set(out) == {"active", "allowed", "stale_allowlist"}
+    # Partial runs never judge staleness (they see a partial surface).
+    assert out["stale_allowlist"] == []
+    assert {f["rule"] for f in out["active"]} >= {"A201", "A202", "A203",
+                                                 "A204"}
+    for f in out["active"]:
+        assert f["file"].endswith("seeded_violations.py")
+        assert f["line"] > 0
+        assert f["severity"] in ("error", "warn", "info")
+
+
+def test_cli_format_github_golden():
+    """--format github emits only workflow-annotation lines, each with a
+    file= (and line=) location and a '::'-free message so the annotation
+    cannot be truncated by the runner."""
+    import re
+
+    proc = _run_cli(
+        "--skip-jaxpr", "--format", "github", "--paths",
+        os.path.join("tests", "analysis_fixtures", "seeded_violations.py"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    lines = [ln for ln in proc.stdout.splitlines() if ln]
+    assert lines, proc.stdout
+    shape = re.compile(
+        r"^::(error|warning|notice) file=[^,]+,line=\d+::[AJ]\d{3}")
+    for ln in lines:
+        assert shape.match(ln), ln
+        _, _, message = ln.split("::", 2)
+        assert "::" not in message, ln
+    assert any("A201" in ln for ln in lines)

@@ -58,3 +58,46 @@ def test_invalid_combinations_rejected():
         main(COMMON + ["--parallel", "dp", "--attn", "ring"])
     with pytest.raises(ValueError, match="cp needs"):
         main(COMMON + ["--parallel", "cp", "--attn", "full"])
+
+
+def test_sentinel_ckpt_resume_smoke(tmp_path):
+    """--sentinel/--ckpt_every/--resume on the dp LM engine: rolling
+    saves land under the monotonic step key, a resumed run continues
+    from the restored step to the (raised) --steps instead of
+    retraining from scratch, and a resume with nothing left is a clear
+    error rather than a silent no-op."""
+    from tpudml.checkpoint import CheckpointManager
+
+    ckpt = str(tmp_path / "ckpt")
+    log = str(tmp_path / "logs")
+    base = COMMON + [
+        "--parallel", "dp", "--n_devices", "2", "--sentinel",
+        "--ckpt_dir", ckpt, "--log_dir", log,
+    ]
+
+    out = main(base + ["--steps", "6", "--ckpt_every", "2"])
+    assert out["steps_run"] == 6
+    mgr = CheckpointManager(ckpt)
+    assert mgr.latest_step() == 6
+
+    out2 = main(base + ["--steps", "8", "--resume"])
+    assert out2["steps_run"] == 8
+    assert np.isfinite(out2["final_loss"])
+    assert mgr.latest_step() == 8
+
+    with pytest.raises(ValueError, match="nothing left to run"):
+        main(base + ["--steps", "8", "--resume"])
+
+
+def test_sentinel_rejected_off_supported_engines():
+    """cp/ep (and single) have no sentinel slot in their optimizer
+    chain; the flag must fail loudly, not silently drop coverage."""
+    for strategy in (["--parallel", "cp", "--n_devices", "2"],
+                     ["--parallel", "single"]):
+        with pytest.raises(ValueError, match="--sentinel composes"):
+            main(COMMON + strategy + ["--sentinel"])
+
+
+def test_resume_requires_ckpt_dir():
+    with pytest.raises(SystemExit):
+        main(COMMON + ["--parallel", "single", "--resume"])

@@ -13,18 +13,43 @@ full rule catalogue):
 - the **AST pass** (``ast_pass``, rules A2xx) lints the source for
   hazards tracing cannot see: Python control flow over traced values,
   PRNG key reuse, epoch loops missing ``set_epoch``, host-clock timing
-  without ``block_until_ready``.
+  without ``block_until_ready``;
+- the **dataflow pass** (``dataflow``, rules J112–J116) abstractly
+  interprets the same traced programs under a per-(value, mesh-axis)
+  replication lattice — missing psums under ``check_rep=False``,
+  shard-dependent while trip counts around collectives, donated-buffer
+  reuse, allreduce-then-shard waste — and feeds the static comm/HBM
+  cost reports in ``cost`` (``--cost`` / ``analysis/cost_report.json``).
 
 Run it as ``python -m tpudml.analysis`` (``--strict`` for CI, paired
 with the committed ``analysis/allowlist.toml``).
 """
 
-from tpudml.analysis.allowlist import load_allowlist, split_allowed
+from tpudml.analysis.allowlist import (
+    load_allowlist,
+    split_allowed,
+    unused_entries,
+)
 from tpudml.analysis.ast_pass import analyze_file, analyze_source, analyze_tree
+from tpudml.analysis.cost import (
+    EntrypointCost,
+    build_cost_report,
+    check_hbm_budget,
+    format_cost_table,
+    peak_live_bytes,
+    summarize_cost,
+    write_cost_report,
+)
+from tpudml.analysis.dataflow import (
+    CommEvent,
+    DataflowResult,
+    analyze_dataflow,
+)
 from tpudml.analysis.entrypoints import (
     ENTRYPOINTS,
     analyze_entrypoint,
     analyze_entrypoints,
+    cost_entrypoints,
 )
 from tpudml.analysis.findings import RULES, Finding, sort_findings
 from tpudml.analysis.jaxpr_pass import (
@@ -35,17 +60,29 @@ from tpudml.analysis.jaxpr_pass import (
 
 __all__ = [
     "RULES",
+    "CommEvent",
+    "DataflowResult",
+    "EntrypointCost",
     "Finding",
     "ENTRYPOINTS",
     "analyze_callable",
     "analyze_closed_jaxpr",
+    "analyze_dataflow",
     "analyze_entrypoint",
     "analyze_entrypoints",
     "analyze_file",
     "analyze_source",
     "analyze_tree",
+    "build_cost_report",
+    "check_hbm_budget",
+    "cost_entrypoints",
     "donation_findings",
+    "format_cost_table",
     "load_allowlist",
+    "peak_live_bytes",
     "sort_findings",
     "split_allowed",
+    "summarize_cost",
+    "unused_entries",
+    "write_cost_report",
 ]

@@ -82,3 +82,15 @@ def split_allowed(
     for f in findings:
         (allowed if any(e.matches(f) for e in entries) else active).append(f)
     return active, allowed
+
+
+def unused_entries(
+    findings: list[Finding], entries: list[AllowEntry],
+) -> list[AllowEntry]:
+    """Entries that matched NO finding in this run — stale suppressions
+    whose bug was fixed (or whose path/rule drifted). ``--strict`` warns
+    on these so an allowlist entry cannot silently outlive the finding
+    it was written for. Only meaningful for runs covering the full
+    surface (all entrypoints + default AST roots); partial runs see a
+    partial finding set and would report false staleness."""
+    return [e for e in entries if not any(e.matches(f) for f in findings)]

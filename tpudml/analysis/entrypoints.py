@@ -42,12 +42,31 @@ from tpudml.analysis.jaxpr_pass import analyze_callable
 
 @dataclass(frozen=True)
 class Program:
-    """One traceable device program: a jitted callable + example args."""
+    """One traceable device program: a jitted callable + example args.
+
+    ``in_specs``/``mesh_axes`` (when the engine attaches them to its
+    step next to ``.jitted``) seed the dataflow interpreter's top-level
+    replication states and the ``--cost`` per-device arithmetic; both
+    default to None for mesh-less single-device programs.
+    """
 
     name: str
     fn: Callable
     args: tuple
     expects_donation: bool = True
+    in_specs: tuple | None = None
+    mesh_axes: dict | None = None
+
+
+def _program(name: str, step, args: tuple, **kw) -> Program:
+    """Build a Program from an engine step, lifting the in_spec metadata
+    the engines attach next to ``.jitted``."""
+    return Program(
+        name, step.jitted, args,
+        in_specs=getattr(step, "in_specs", None),
+        mesh_axes=getattr(step, "mesh_axes", None),
+        **kw,
+    )
 
 
 def _np():
@@ -115,7 +134,7 @@ def build_task2_dp() -> list[Program]:
     ts = dp.create_state(seed_key(0))
     step = dp.make_train_step()
     x, y = _lenet_batch()
-    return [Program("task2_dp", step.jitted, (ts, x, y))]
+    return [_program("task2_dp", step, (ts, x, y))]
 
 
 def build_dp_zero1() -> list[Program]:
@@ -132,7 +151,7 @@ def build_dp_zero1() -> list[Program]:
     ts = dp.create_state(seed_key(0))
     step = dp.make_train_step()
     x, y = _lenet_batch()
-    return [Program("dp_zero1", step.jitted, (ts, x, y))]
+    return [_program("dp_zero1", step, (ts, x, y))]
 
 
 def build_dp_sentinel() -> list[Program]:
@@ -151,7 +170,7 @@ def build_dp_sentinel() -> list[Program]:
     ts = dp.create_state(seed_key(0))
     step = dp.make_train_step()
     x, y = _lenet_batch()
-    return [Program("dp_sentinel", step.jitted, (ts, x, y))]
+    return [_program("dp_sentinel", step, (ts, x, y))]
 
 
 def build_task4_mp() -> list[Program]:
@@ -165,7 +184,7 @@ def build_task4_mp() -> list[Program]:
     ts = mp.create_state(seed_key(0))
     step = mp.make_train_step()
     x, y = _lenet_batch()
-    return [Program("task4_mp", step.jitted, (ts, x, y))]
+    return [_program("task4_mp", step, (ts, x, y))]
 
 
 def build_fsdp() -> list[Program]:
@@ -181,7 +200,7 @@ def build_fsdp() -> list[Program]:
     rng = np.random.default_rng(0)
     x = rng.normal(size=(4, 784)).astype(np.float32)
     y = rng.integers(0, 10, size=(4,)).astype(np.int32)
-    return [Program("fsdp", step.jitted, (ts, x, y))]
+    return [_program("fsdp", step, (ts, x, y))]
 
 
 def build_tp_fused() -> list[Program]:
@@ -200,7 +219,7 @@ def build_tp_fused() -> list[Program]:
     ts = eng.create_state(seed_key(0))
     step = eng.make_train_step()
     x, y = _lm_batch()
-    return [Program("tp_fused", step.jitted, (ts, x, y))]
+    return [_program("tp_fused", step, (ts, x, y))]
 
 
 def build_fsdp_fused() -> list[Program]:
@@ -216,7 +235,7 @@ def build_fsdp_fused() -> list[Program]:
     ts = eng.create_state(seed_key(0))
     step = eng.make_train_step()
     x, y = _lm_batch()
-    return [Program("fsdp_fused", step.jitted, (ts, x, y))]
+    return [_program("fsdp_fused", step, (ts, x, y))]
 
 
 def build_pp_gpipe() -> list[Program]:
@@ -240,7 +259,7 @@ def build_pp_gpipe() -> list[Program]:
     rng = np.random.default_rng(0)
     x = rng.normal(size=(4, 4)).astype(np.float32)
     y = rng.integers(0, 4, size=(4,)).astype(np.int32)
-    return [Program("pp_gpipe", step.jitted, (ts, x, y))]
+    return [_program("pp_gpipe", step, (ts, x, y))]
 
 
 def build_cp_ring() -> list[Program]:
@@ -253,7 +272,7 @@ def build_cp_ring() -> list[Program]:
     ts = cp.create_state(seed_key(0))
     step = cp.make_train_step()
     x, y = _lm_batch()
-    return [Program("cp_ring", step.jitted, (ts, x, y))]
+    return [_program("cp_ring", step, (ts, x, y))]
 
 
 def build_ep_moe() -> list[Program]:
@@ -266,7 +285,7 @@ def build_ep_moe() -> list[Program]:
     ts = ep.create_state(seed_key(0))
     step = ep.make_train_step()
     x, y = _lm_batch()
-    return [Program("ep_moe", step.jitted, (ts, x, y))]
+    return [_program("ep_moe", step, (ts, x, y))]
 
 
 def build_lm_bf16() -> list[Program]:
@@ -346,7 +365,9 @@ ENTRYPOINTS: dict[str, Callable[[], list[Program]]] = {
 }
 
 
-def analyze_entrypoint(name: str) -> list[Finding]:
+def analyze_entrypoint(
+    name: str, hbm_budget_bytes: int | None = None
+) -> list[Finding]:
     """Build one entrypoint and run every jaxpr rule on its program(s).
 
     A builder that raises becomes a J100 finding rather than an
@@ -363,12 +384,55 @@ def analyze_entrypoint(name: str) -> list[Finding]:
     for prog in programs:
         findings.extend(analyze_callable(
             prog.fn, prog.args, entrypoint=prog.name,
-            expects_donation=prog.expects_donation))
+            expects_donation=prog.expects_donation,
+            in_specs=prog.in_specs, mesh_axes=prog.mesh_axes,
+            hbm_budget_bytes=hbm_budget_bytes))
     return findings
 
 
-def analyze_entrypoints(names: list[str] | None = None) -> list[Finding]:
+def analyze_entrypoints(
+    names: list[str] | None = None, hbm_budget_bytes: int | None = None
+) -> list[Finding]:
     findings: list[Finding] = []
     for name in names or list(ENTRYPOINTS):
-        findings.extend(analyze_entrypoint(name))
+        findings.extend(analyze_entrypoint(name, hbm_budget_bytes))
     return findings
+
+
+def cost_entrypoints(names: list[str] | None = None):
+    """Static cost summaries (``--cost``) for the registered entrypoints:
+    one dataflow walk + CommEvent aggregation + peak-HBM estimate per
+    program. Returns ``(costs, findings)`` — build/trace failures become
+    an EntrypointCost carrying ``error`` plus a J100 finding, so the cost
+    table never hides a broken entrypoint."""
+    import jax
+
+    from tpudml.analysis.cost import EntrypointCost, summarize_cost
+    from tpudml.analysis.dataflow import analyze_dataflow
+
+    costs = []
+    findings: list[Finding] = []
+    for name in names or list(ENTRYPOINTS):
+        try:
+            programs = ENTRYPOINTS[name]()
+        except Exception as e:  # noqa: BLE001 - converted to a finding
+            findings.append(Finding(
+                "J100", f"entrypoint failed to build: {e!r}",
+                entrypoint=name))
+            costs.append(EntrypointCost(entrypoint=name, error=repr(e)))
+            continue
+        for prog in programs:
+            try:
+                closed = jax.make_jaxpr(prog.fn)(*prog.args)
+            except Exception as e:  # noqa: BLE001 - converted to a finding
+                findings.append(Finding(
+                    "J100", f"trace failed: {e!r}", entrypoint=prog.name))
+                costs.append(EntrypointCost(entrypoint=prog.name,
+                                            error=repr(e)))
+                continue
+            flow = analyze_dataflow(closed, prog.name,
+                                    in_specs=prog.in_specs,
+                                    mesh_axes=prog.mesh_axes)
+            findings.extend(flow.findings)
+            costs.append(summarize_cost(prog.name, flow, closed))
+    return costs, findings

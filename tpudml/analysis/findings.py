@@ -46,6 +46,19 @@ RULES: dict[str, tuple[str, str]] = {
     "J111": (INFO, "optimizer update consumes gradients with no finiteness "
                    "predicate anywhere in the step (one NaN microbatch "
                    "poisons the weights unrecoverably)"),
+    "J112": (ERROR, "shard_map output declared replicated over an axis the "
+                    "body value varies on (missing psum / lost transpose "
+                    "factor under check_rep=False)"),
+    "J113": (ERROR, "while loop trip count varies per shard while its "
+                    "body/cond issue collectives over the same axis "
+                    "(collective imbalance: the slice deadlocks)"),
+    "J114": (ERROR, "donated buffer consumed again after the donating call "
+                    "(XLA may have aliased the memory away)"),
+    "J115": (INFO, "allreduce (psum) whose result is consumed only by "
+                   "per-shard slices (a psum_scatter moves ~half the "
+                   "bytes)"),
+    "J116": (WARN, "static peak-live-buffer estimate exceeds the configured "
+                   "HBM budget"),
     "A201": (WARN, "Python for/if over a traced (jnp/lax) value"),
     "A202": (WARN, "jax.random key consumed more than once without split"),
     "A203": (WARN, "epoch loop iterates a loader without set_epoch"),
@@ -75,6 +88,17 @@ HINTS: dict[str, str] = {
     "J111": "wrap the optimizer with resilience.attach_sentinel (engines: "
             "sentinel=True) so non-finite steps are skipped in-graph with "
             "the previous state carried forward bit-exactly",
+    "J112": "reduce before returning: psum/all_gather the shard-local "
+            "value over the axis (or declare the output sharded in "
+            "out_specs if per-shard results are intended)",
+    "J113": "derive the loop predicate from a reduced value (psum/pmax of "
+            "the local condition) so every shard agrees on the trip count",
+    "J114": "thread the updated value out of the donating call instead of "
+            "reusing the donated input (donate_argnums aliases its buffer)",
+    "J115": "replace psum+dynamic_slice(axis_index) with psum_scatter: "
+            "each shard receives exactly the piece it keeps",
+    "J116": "shard or rematerialize the largest live buffers, or raise "
+            "--hbm_budget if the estimate is for a larger part",
     "A201": "use lax.cond/lax.fori_loop/jnp.where, or materialize with "
             "float(...) first if this is host-side code",
     "A202": "key, sub = jax.random.split(key) before the second use",

@@ -53,6 +53,20 @@ multi-host slice:
         (``resilience.GradSentinel``) closes. Sentinel-wrapped steps
         carry the finiteness check in-graph and stay silent.
 
+- J114  a buffer donated to a jitted call (``donated_invars``) consumed
+        AGAIN afterwards — by a later equation at the same level, by the
+        program's own outputs, or twice within the one call: XLA may
+        have aliased the memory to an output, so the second read sees
+        whatever the donating program wrote over it.
+
+Since the replication-lattice interpreter landed
+(:mod:`tpudml.analysis.dataflow`), ``analyze_closed_jaxpr`` also runs
+the sharding-aware dataflow rules over the same traced program: J112
+(missing psum under ``check_rep=False``), J113 (shard-dependent while
+trip counts around collectives), J115 (allreduce-then-shard), and —
+when an HBM budget is supplied — J116 from the static cost walk
+(:mod:`tpudml.analysis.cost`).
+
 The pass is backend-free: everything works on abstract values on CPU.
 """
 
@@ -567,12 +581,55 @@ def _check_unguarded_update(closed, entrypoint: str,
     ))
 
 
+def _check_donated_reuse(jaxpr, entrypoint: str,
+                         findings: list[Finding]) -> None:
+    """J114: a var donated into a pjit is read again at the same level.
+
+    ``donate_argnums`` tells XLA it may alias the argument's buffer to
+    an output; a read after the donating call (a later equation) or a
+    second occurrence among the same call's arguments observes clobbered
+    memory. A donated invar appearing directly in the enclosing
+    program's outvars is NOT flagged: that is jax forwarding an
+    unmodified input to an output (common for cache slots a step leaves
+    untouched), not a host-level reuse.
+    """
+    for idx, eqn in enumerate(jaxpr.eqns):
+        donated = eqn.params.get("donated_invars")
+        if eqn.primitive.name != "pjit" or not donated or not any(donated):
+            continue
+        callee = str(eqn.params.get("name", "")) or "<anonymous>"
+        for pos, (v, don) in enumerate(zip(eqn.invars, donated)):
+            if not don or hasattr(v, "val"):
+                continue
+            reuse = None
+            if any(v is w for j, w in enumerate(eqn.invars)
+                   if j != pos):
+                reuse = f"passed again to the same call '{callee}'"
+            else:
+                for later in jaxpr.eqns[idx + 1:]:
+                    if any(v is w for w in later.invars):
+                        reuse = (f"consumed again by a later "
+                                 f"'{later.primitive.name}' equation")
+                        break
+            if reuse:
+                f, ln = _src_loc(eqn)
+                findings.append(Finding(
+                    "J114",
+                    f"argument {pos} is donated to jitted call '{callee}' "
+                    f"but its buffer is {reuse} — XLA may alias donated "
+                    f"memory to an output, so the second read observes "
+                    f"overwritten bytes",
+                    file=f, line=ln, entrypoint=entrypoint,
+                ))
+
+
 def _walk(obj, bound: frozenset[str], entrypoint: str,
           findings: list[Finding]) -> None:
     jaxpr, consts = _inner_jaxpr(obj)
     _check_consts(consts, entrypoint, findings)
     _check_upcasts(jaxpr, entrypoint, findings)
     _check_ragged_transpose(jaxpr, entrypoint, findings)
+    _check_donated_reuse(jaxpr, entrypoint, findings)
     for eqn in jaxpr.eqns:
         name = eqn.primitive.name
         if name in COLLECTIVE_PRIMS:
@@ -638,12 +695,30 @@ def _check_consts(consts, entrypoint: str, findings: list[Finding]) -> None:
             ))
 
 
-def analyze_closed_jaxpr(closed, entrypoint: str = "") -> list[Finding]:
-    """All jaxpr-level findings (J101-J105, J107-J111) for one traced
-    program."""
+def analyze_closed_jaxpr(
+    closed,
+    entrypoint: str = "",
+    in_specs=None,
+    mesh_axes: dict[str, int] | None = None,
+    hbm_budget_bytes: int | None = None,
+) -> list[Finding]:
+    """All jaxpr-level findings (J101-J105, J107-J116) for one traced
+    program: the local pattern rules plus the replication-lattice
+    dataflow rules. ``in_specs``/``mesh_axes`` seed the interpreter's
+    top-level states (engines attach them to their jitted steps);
+    ``hbm_budget_bytes`` arms J116."""
+    from tpudml.analysis.cost import check_hbm_budget, summarize_cost
+    from tpudml.analysis.dataflow import analyze_dataflow
+
     findings: list[Finding] = []
     _walk(closed, frozenset(), entrypoint, findings)
     _check_unguarded_update(closed, entrypoint, findings)
+    flow = analyze_dataflow(closed, entrypoint, in_specs=in_specs,
+                            mesh_axes=mesh_axes)
+    findings.extend(flow.findings)
+    if hbm_budget_bytes:
+        cost = summarize_cost(entrypoint, flow, closed)
+        findings.extend(check_hbm_budget(cost, hbm_budget_bytes))
     return findings
 
 
@@ -713,6 +788,9 @@ def analyze_callable(
     args: tuple,
     entrypoint: str = "",
     expects_donation: bool = False,
+    in_specs=None,
+    mesh_axes: dict[str, int] | None = None,
+    hbm_budget_bytes: int | None = None,
 ) -> list[Finding]:
     """Trace ``fn(*args)`` abstractly and run every jaxpr rule on it.
 
@@ -737,7 +815,9 @@ def analyze_callable(
         return [Finding("J100", f"trace failed: {e!r}", entrypoint=entrypoint)]
     except Exception as e:  # noqa: BLE001 - converted to a finding
         return [Finding("J100", f"trace failed: {e!r}", entrypoint=entrypoint)]
-    findings = analyze_closed_jaxpr(closed, entrypoint)
+    findings = analyze_closed_jaxpr(
+        closed, entrypoint, in_specs=in_specs, mesh_axes=mesh_axes,
+        hbm_budget_bytes=hbm_budget_bytes)
     if expects_donation and hasattr(fn, "lower"):
         try:
             text = fn.lower(*args).as_text()

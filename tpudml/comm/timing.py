@@ -30,18 +30,55 @@ import jax
 import numpy as np
 
 
+# Ring-model wire bytes moved per device for one collective, as a
+# function of the per-shard input payload P and the axis size N. psum is
+# a ring allreduce (reduce-scatter + all-gather legs, 2·P·(N−1)/N);
+# all_gather ships the local shard to the other N−1 devices;
+# reduce_scatter/all_to_all move one (N−1)/N fraction; ppermute ships
+# the whole buffer once. The static analyzer (analysis/dataflow.py) and
+# the runtime byte accounting below share this table so the --cost
+# cross-validation compares like against like.
+_WIRE_MODEL = {
+    "psum": lambda p, n: 2.0 * p * (n - 1) / n,
+    "pmax": lambda p, n: 2.0 * p * (n - 1) / n,
+    "pmin": lambda p, n: 2.0 * p * (n - 1) / n,
+    "pbroadcast": lambda p, n: p * (n - 1) / n,
+    "all_gather": lambda p, n: float(p * (n - 1)),
+    "psum_scatter": lambda p, n: p * (n - 1) / n,
+    "reduce_scatter": lambda p, n: p * (n - 1) / n,
+    "all_to_all": lambda p, n: p * (n - 1) / n,
+    "pgather": lambda p, n: p * (n - 1) / n,
+    "ppermute": lambda p, n: float(p),
+}
+
+
+def collective_wire_bytes(kind: str, payload_bytes: float, world: int) -> float:
+    """Ring-model bytes one device moves for a single ``kind`` collective
+    over an axis of size ``world``, given per-shard input ``payload_bytes``.
+    Unknown kinds fall back to shipping the payload once."""
+    if world <= 1:
+        return 0.0
+    fn = _WIRE_MODEL.get(kind)
+    return float(fn(payload_bytes, world) if fn else payload_bytes)
+
+
 @dataclass
 class CommStats:
-    """Accumulates the reference's ``comm_time_sum`` (model-mp.py:48,79)."""
+    """Accumulates the reference's ``comm_time_sum`` (model-mp.py:48,79),
+    plus — since the static cost reports landed — the ring-model wire
+    bytes each timed call moved, so measured and predicted comm volume
+    can be compared on the same units."""
 
     comm_time_s: float = 0.0
     calls: int = 0
     per_call_s: list = field(default_factory=list)
+    comm_bytes: float = 0.0
 
-    def add(self, dt: float) -> None:
+    def add(self, dt: float, nbytes: float = 0.0) -> None:
         self.comm_time_s += dt
         self.calls += 1
         self.per_call_s.append(dt)
+        self.comm_bytes += nbytes
 
     def percentiles(self) -> dict:
         """p50/p99 of the recorded per-call spans (empty dict when no
@@ -67,6 +104,8 @@ class CommStats:
                 f" (p50 {pct['p50_s'] * 1e3:.2f}ms,"
                 f" p99 {pct['p99_s'] * 1e3:.2f}ms)"
             )
+        if self.comm_bytes:
+            line += f", {self.comm_bytes / 1e6:.2f} MB moved/device"
         return line
 
 

@@ -576,6 +576,9 @@ class ContextParallel:
             self._throttle.after_step(out[1]["loss"])
             return out
 
-        # Raw program for tpudml.analysis (wrapper does host-side work).
+        # Raw program for tpudml.analysis (wrapper does host-side work);
+        # in_specs/mesh_axes seed the dataflow interpreter and --cost.
         step.jitted = jitted
+        step.in_specs = (P(), spec, spec)
+        step.mesh_axes = dict(self.mesh.shape)
         return step

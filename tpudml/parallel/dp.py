@@ -50,6 +50,22 @@ from tpudml.train import (
 PyTree = Any
 
 
+def _program_wire_bytes(fn, *args) -> float:
+    """Ring-model bytes/device the program's explicit collectives move,
+    from a static walk of its traced jaxpr (analysis/dataflow — the same
+    wire model the ``--cost`` reports use, so measured ``CommStats``
+    byte counters and the static cost tables stay comparable). Traced
+    once per step build; returns 0 when the walk cannot run."""
+    from tpudml.analysis.dataflow import analyze_dataflow
+
+    try:
+        closed = jax.make_jaxpr(fn)(*args)
+        flow = analyze_dataflow(closed)
+        return sum(ev.wire_bytes * ev.trips for ev in flow.comm_events)
+    except Exception:
+        return 0.0
+
+
 class DataParallel:
     """DP training engine over a mesh ``data`` axis.
 
@@ -459,8 +475,12 @@ class DataParallel:
 
         # Expose the raw program for tpudml.analysis: the wrapper above
         # does host work (shard_batch, throttle) that make_jaxpr must not
-        # see, but the jitted step is exactly what runs on the chip.
+        # see, but the jitted step is exactly what runs on the chip. The
+        # in_specs/mesh_axes metadata seeds the dataflow interpreter's
+        # top-level replication states and the --cost per-device math.
         step.jitted = jitted
+        step.in_specs = (spec, P(self.axis_name), P(self.axis_name))
+        step.mesh_axes = dict(self.mesh.shape)
         return step
 
     # ----------------------------------------------------------- split step
@@ -525,6 +545,8 @@ class DataParallel:
                 step=ts.step + 1,
             )
 
+        wire_bytes_cache: list = []
+
         def step(ts: TrainState, images, labels):
             images, labels = self.shard_batch(images, labels)
             stacked_grads, stacked_state, stacked_metrics = grad_fn(ts, images, labels)
@@ -542,7 +564,11 @@ class DataParallel:
             t0 = time.perf_counter()
             grads, model_state = agg_fn(stacked_grads, stacked_state)
             jax.block_until_ready(grads)
-            self.comm_stats.add(time.perf_counter() - t0)
+            if not wire_bytes_cache:
+                wire_bytes_cache.append(
+                    _program_wire_bytes(agg_fn, stacked_grads, stacked_state))
+            self.comm_stats.add(time.perf_counter() - t0,
+                                nbytes=wire_bytes_cache[0])
             new_ts = apply_fn(ts, grads, model_state)
             metrics = {
                 "loss": jnp.mean(stacked_metrics["loss"]),
@@ -632,6 +658,7 @@ class DataParallel:
         replicated split step, charging the whole weight-update exchange
         to ``comm_stats``."""
         grad_fn, ex_fn = self._zero1_programs()
+        wire_bytes_cache: list = []
 
         def step(ts: TrainState, images, labels):
             images, labels = self.shard_batch(images, labels)
@@ -648,7 +675,11 @@ class DataParallel:
             t0 = time.perf_counter()
             new_ts = ex_fn(ts, stacked_grads, stacked_state)
             jax.block_until_ready(new_ts.params)
-            self.comm_stats.add(time.perf_counter() - t0)
+            if not wire_bytes_cache:
+                wire_bytes_cache.append(_program_wire_bytes(
+                    ex_fn, ts, stacked_grads, stacked_state))
+            self.comm_stats.add(time.perf_counter() - t0,
+                                nbytes=wire_bytes_cache[0])
             metrics = {
                 "loss": jnp.mean(stacked_metrics["loss"]),
                 "accuracy": jnp.mean(stacked_metrics["accuracy"]),

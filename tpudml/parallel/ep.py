@@ -230,6 +230,9 @@ class ExpertParallel:
             self._throttle.after_step(out[1]["loss"])
             return out
 
-        # Raw program for tpudml.analysis (wrapper does host-side work).
+        # Raw program for tpudml.analysis (wrapper does host-side work);
+        # in_specs/mesh_axes seed the dataflow interpreter and --cost.
         step.jitted = jitted
+        step.in_specs = (specs, batch_spec, batch_spec)
+        step.mesh_axes = dict(self.mesh.shape)
         return step

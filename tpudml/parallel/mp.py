@@ -328,7 +328,13 @@ class GSPMDParallel:
             return out
 
         # Raw program for tpudml.analysis (wrapper does host-side work).
+        # in_specs/mesh_axes seed the dataflow interpreter's top-level
+        # states; note GSPMD inserts this engine's collectives at
+        # partitioning time, so the static --cost comm volume here only
+        # covers explicit shard_map regions (e.g. the fused sharded head).
         step.jitted = jitted
+        step.in_specs = (self._specs, batch_spec, batch_spec)
+        step.mesh_axes = dict(self.mesh.shape)
         return step
 
     # ------------------------------------------------------------- evaluate

@@ -426,8 +426,11 @@ class GPipe:
             self._throttle.after_step(out[1]["loss"])
             return out
 
-        # Raw program for tpudml.analysis (wrapper does host-side work).
+        # Raw program for tpudml.analysis (wrapper does host-side work);
+        # in_specs/mesh_axes seed the dataflow interpreter and --cost.
         step.jitted = jitted
+        step.in_specs = (specs, self._batch_spec(), self._batch_spec())
+        step.mesh_axes = dict(self.mesh.shape)
         return step
 
     # ------------------------------------------------------------- reference
