@@ -594,19 +594,39 @@ def main_moe() -> None:
     print(json.dumps(bench_moe(on_tpu)))
 
 
-def bench_serve(on_tpu) -> dict:
-    """``--serve`` report, two sections:
+def bench_serve(on_tpu, smoke=False) -> dict:
+    """``--serve`` report for the multi-tenant serving tier.
+
+    ``smoke=True`` (tier-1 canary, seconds on CPU): one seeded workload
+    through dense / paged / paged+spec engines, asserting token parity —
+    the wiring check that the three compiled decode paths agree.
+
+    The full report (minutes on CPU; ``@slow`` in tests), six sections:
 
     (a) the A/B the KV cache exists for — per-token decode step time,
-        cached (ONE token through ``apply_decode`` over a [B, T, Hkv, Dh]
-        cache) vs cacheless (full forward over the whole T-token history
-        per emitted token) at history lengths T ∈ {512, 1024}. Each step
-        is timed individually (warm, median of reps) with a host fetch of
-        the emitted tokens as the sync barrier — per-token latency is a
-        single-dispatch metric, so fori differencing does not apply.
-    (b) engine throughput/latency under the seeded Poisson load
-        generator at fixed QPS points (plus the qps=inf saturation row):
-        tokens/sec, p50/p99 per-token and end-to-end latency.
+        cached vs cacheless (full forward over the whole history) at
+        T ∈ {512, 1024}, per-call median with a host token fetch as the
+        sync barrier;
+    (b) dense engine throughput/latency at fixed QPS points;
+    (c) equal-HBM paged vs dense on a mixed short/long workload where
+        dense strands >50% of its reserved rows — occupancy, HBM-row
+        occupancy, and tokens per decode step at byte-identical KV HBM;
+    (d) prefix sharing — admit→first-token wall time for requests
+        repeating a 96-token head, shared vs unshared pages;
+    (e) speculative decoding — accepted_len and target-step collapse
+        with a 1-layer trunk draft on damped-residual params (a
+        converged-model stand-in: blocks contribute small corrections,
+        the regime where a trunk draft agrees; random-init blocks
+        disagree at chance level and would measure nothing);
+    (f) a slots × page_size × cache_kind × spec_k Pareto sweep (16 paged
+        rows): virtual tokens/sec and p50/p99 TTFT/TPOT on the
+        deterministic step clock.
+
+    All scheduler-level rows run the virtual step clock
+    (``step_time_s``), so their numbers are a pure function of
+    (seed, config) on any host; wall seconds ride along for scale.
+    CPU-dryrun numbers are wiring + ratio sanity, not chip numbers —
+    BASELINE.md protocol requires a named-chip rerun before recording.
     """
     import math
     import statistics
@@ -615,9 +635,77 @@ def bench_serve(on_tpu) -> dict:
 
     from tpudml.models import TransformerLM
     from tpudml.serve import (
-        ServeConfig, ServingEngine, make_cacheless_decode_step,
+        Request, ServeConfig, ServingEngine, make_cacheless_decode_step,
         make_decode_step, poisson_workload,
     )
+
+    STEP_S = 0.01  # virtual decode-step clock for all scheduler rows
+
+    def pct(xs, q):
+        xs = [x for x in xs if x is not None]
+        if not xs:
+            return None
+        return round(float(np.percentile(np.asarray(xs), q)), 5)
+
+    def hbm_occupancy(rep, hbm_rows):
+        """Fraction of KV HBM rows holding LIVE request state, averaged
+        over decode steps — replayed from the admit/evict event log."""
+        start, end = {}, {}
+        for e in rep.events:
+            kind, rid, _slot, step = e[:4]
+            if kind == "admit":
+                start[rid] = step
+            elif kind in ("evict", "expire"):
+                end[rid] = step
+        row_steps = 0
+        for rid, s0 in start.items():
+            st = rep.requests[rid]
+            used = st.prompt_len + len(st.tokens)
+            row_steps += (end.get(rid, rep.decode_steps) - s0) * used
+        denom = rep.decode_steps * hbm_rows
+        return round(row_steps / denom, 4) if denom else 0.0
+
+    if smoke:
+        # Tier-1 canary: parity across the three decode paths, tiny
+        # model, virtual clock — deterministic and CPU-cheap.
+        model = TransformerLM(vocab_size=64, embed_dim=32, num_heads=4,
+                              num_kv_heads=2, num_layers=2, max_len=32,
+                              rope=True, impl="full")
+        params, _ = model.init(jax.random.key(0))
+
+        def run_mode(**kw):
+            scfg = ServeConfig(slots=2, max_len=32, prefill_chunk=4,
+                               step_time_s=STEP_S, **kw)
+            reqs, _ = poisson_workload(6, math.inf, 11, vocab_size=64,
+                                       prompt_len=(2, 8), new_tokens=(3, 6))
+            return ServingEngine(model, params, scfg, draft_layers=1).run(reqs)
+
+        dense = run_mode()
+        paged = run_mode(cache_layout="paged", page_size=4)
+        spec = run_mode(cache_layout="paged", page_size=4, spec_k=2)
+
+        def toks(rep):
+            return {r: rep.requests[r].tokens for r in rep.requests}
+
+        rows = {
+            name: {
+                "decode_steps": rep.decode_steps,
+                "tokens_per_step": round(
+                    rep.generated_tokens / max(rep.decode_steps, 1), 3),
+                "occupancy": round(rep.occupancy, 4),
+            }
+            for name, rep in (("dense", dense), ("paged", paged),
+                              ("paged_spec", spec))
+        }
+        rows["paged_spec"]["mean_accepted_len"] = round(
+            spec.mean_accepted_len, 3)
+        return {
+            "metric": "serving_multitenant_parity_smoke",
+            "on_tpu": on_tpu,
+            "smoke": True,
+            "parity_dense_paged_spec": toks(dense) == toks(paged) == toks(spec),
+            "rows": rows,
+        }
 
     if on_tpu:
         cfg = dict(vocab_size=32768, embed_dim=512, num_heads=8,
@@ -701,16 +789,173 @@ def bench_serve(on_tpu) -> dict:
             "decode_steps": rep.decode_steps,
         }
 
+    # (c) Equal-HBM paged vs dense. Dense reserves 4 slots × 128 rows =
+    # 512 KV rows; paged provisions 65 pages × 8 rows = 520 (the +8 is
+    # the reserved garbage page) but maps them to 16 slots. The mixed
+    # workload (20 short requests stranding ~87% of a dense row, 4 long
+    # ones) is exactly where per-slot reservation wastes the HBM.
+    rng = np.random.default_rng(3)
+    mixed = []
+    for i in range(24):
+        plen, new = (48, 48) if i % 6 == 0 else (8, 8)
+        mixed.append(Request(
+            rid=i, prompt=rng.integers(
+                0, cfg["vocab_size"], plen).astype(np.int32),
+            max_new_tokens=new, arrival_time=0.0))
+
+    def run_hbm(scfg, hbm_rows):
+        t0 = time.perf_counter()
+        rep = ServingEngine(serve_model, serve_params, scfg).run(mixed)
+        wall = time.perf_counter() - t0
+        return {
+            "hbm_rows": hbm_rows,
+            "decode_steps": rep.decode_steps,
+            "occupancy": round(rep.occupancy, 4),
+            "hbm_occupancy": hbm_occupancy(rep, hbm_rows),
+            "tokens_per_step": round(
+                rep.generated_tokens / max(rep.decode_steps, 1), 3),
+            "tokens_per_sec_virtual": round(rep.tokens_per_sec, 2),
+            "wall_s": round(wall, 2),
+        }, rep
+
+    dense_row, dense_rep = run_hbm(
+        ServeConfig(slots=4, max_len=128, prefill_chunk=8,
+                    step_time_s=STEP_S), 4 * 128)
+    paged_row, _ = run_hbm(
+        ServeConfig(slots=16, max_len=128, prefill_chunk=8,
+                    cache_layout="paged", page_size=8, num_pages=65,
+                    step_time_s=STEP_S), 65 * 8)
+    # How much of the dense reservation the workload could ever use:
+    # resident-step-weighted used-rows fraction of the max_len rows each
+    # admitted request pins for its whole lifetime.
+    tok_steps = sum(len(s.tokens) for s in dense_rep.requests.values())
+    used = sum((s.prompt_len + len(s.tokens)) * len(s.tokens)
+               for s in dense_rep.requests.values())
+    dense_row["stranded_hbm_frac"] = round(1 - used / (128 * tok_steps), 4)
+    equal_hbm = {
+        "workload": "20 short (8+8) + 4 long (48+48), all at t=0",
+        "rows": {"dense": dense_row, "paged": paged_row},
+        "paged_over_dense_tokens_per_step": round(
+            paged_row["tokens_per_step"] / dense_row["tokens_per_step"], 3),
+    }
+
+    # (d) Prefix sharing: 6 requests repeating a 96-token head with a
+    # 4-token divergent tail; slots=1 serializes them so admit→first-
+    # token is each request's OWN prefill cost (wall clock — prefill is
+    # real compute, which is the point). Request 0 is excluded from both
+    # means: it pays the compiles AND (shared run) populates the cache.
+    head = rng.integers(0, cfg["vocab_size"], 96).astype(np.int32)
+    tails = [rng.integers(0, cfg["vocab_size"], 4).astype(np.int32)
+             for _ in range(6)]
+
+    def run_prefix(share):
+        scfg = ServeConfig(slots=1, max_len=128, prefill_chunk=8,
+                           cache_layout="paged", page_size=8,
+                           prefix_sharing=share)
+        reqs = [Request(rid=i, prompt=np.concatenate([head, tails[i]]),
+                        max_new_tokens=8, arrival_time=0.0)
+                for i in range(6)]
+        rep = ServingEngine(serve_model, serve_params, scfg).run(reqs)
+        ttfts = [rep.requests[i].first_token - rep.requests[i].admit_start
+                 for i in range(1, 6)]
+        return float(np.mean(ttfts)), rep
+
+    unshared_s, _ = run_prefix(False)
+    shared_s, shared_rep = run_prefix(True)
+    prefix_sharing = {
+        "workload": "6 requests, shared 96-token head, 4-token tails",
+        "admit_to_first_token_ms_unshared": round(unshared_s * 1e3, 3),
+        "admit_to_first_token_ms_shared": round(shared_s * 1e3, 3),
+        "speedup_admit_to_first_token": round(unshared_s / shared_s, 2),
+        "pool_stats": shared_rep.pool_stats,
+        "shared_pages_per_hit": shared_rep.requests[1].shared_pages,
+    }
+
+    # (e) Speculative decoding on damped-residual params (see docstring):
+    # blocks scaled ×0.25 so the 1-layer trunk draft tracks the 2-layer
+    # target the way a draft tracks a converged model. Parity is checked
+    # against the plain engine on the SAME params — damping changes what
+    # is computed, never whether spec preserves it.
+    damped = {k: (jax.tree.map(lambda x: x * 0.25, v)
+                  if k.startswith("block") else v)
+              for k, v in serve_params.items()}
+    rep_head = np.tile(np.array([5, 7, 11, 13], np.int32), 6)
+
+    def spec_reqs():
+        return [Request(rid=i, prompt=rep_head.copy(), max_new_tokens=24,
+                        arrival_time=0.0) for i in range(4)]
+
+    srep = ServingEngine(
+        serve_model, damped,
+        ServeConfig(slots=4, max_len=128, prefill_chunk=8, spec_k=3,
+                    step_time_s=STEP_S),
+        draft_layers=1).run(spec_reqs())
+    dref = ServingEngine(
+        serve_model, damped,
+        ServeConfig(slots=4, max_len=128, prefill_chunk=8,
+                    step_time_s=STEP_S)).run(spec_reqs())
+    spec_decode = {
+        "workload": "4 requests, repetitive 24-token prompt, 24 new",
+        "draft": "1-layer trunk (draft_from_trunk), spec_k=3",
+        "mean_accepted_len": round(srep.mean_accepted_len, 3),
+        "tokens_per_target_step": round(1 + srep.mean_accepted_len, 3),
+        "decode_steps_spec": srep.decode_steps,
+        "decode_steps_dense": dref.decode_steps,
+        "parity": all(srep.requests[r].tokens == dref.requests[r].tokens
+                      for r in srep.requests),
+    }
+
+    # (f) Pareto: slots × page_size × cache_kind × spec_k, all paged,
+    # equal-capacity pools, one seeded finite-QPS workload, virtual
+    # clock. TTFT/TPOT come from the annotated workload ledger — the
+    # same per-request fields task6 asserts exact accounting on.
+    pareto_rows: dict[str, dict] = {}
+    for slots_n in (2, 4):
+        for page in (8, 16):
+            for kind in ("f32", "int8"):
+                for k_spec in (0, 2):
+                    scfg = ServeConfig(
+                        slots=slots_n, max_len=64, prefill_chunk=8,
+                        cache_layout="paged", page_size=page,
+                        cache_kind=kind, spec_k=k_spec,
+                        step_time_s=STEP_S)
+                    eng = ServingEngine(serve_model, serve_params, scfg,
+                                        draft_layers=1)
+                    reqs, ledger = poisson_workload(
+                        10, 8.0, 7, vocab_size=cfg["vocab_size"],
+                        prompt_len=(8, 24), new_tokens=(8, 16))
+                    t0 = time.perf_counter()
+                    rep = eng.run(reqs)
+                    wall = time.perf_counter() - t0
+                    rep.annotate_ledger(ledger)
+                    ttft = [r["ttft_s"] for r in ledger.values()]
+                    tpot = [r["tpot_s"] for r in ledger.values()]
+                    key = f"s{slots_n}_p{page}_{kind}_k{k_spec}"
+                    pareto_rows[key] = {
+                        "tokens_per_sec_virtual": round(
+                            rep.tokens_per_sec, 2),
+                        "ttft_p50_s": pct(ttft, 50),
+                        "ttft_p99_s": pct(ttft, 99),
+                        "tpot_p50_s": pct(tpot, 50),
+                        "tpot_p99_s": pct(tpot, 99),
+                        "decode_steps": rep.decode_steps,
+                        "wall_s": round(wall, 2),
+                    }
+
     return {
-        "metric": "serving_cached_vs_cacheless_decode",
+        "metric": "serving_multitenant_tier",
         "config": {**cfg, "slots": slots},
-        "protocol": "per_call_median",
+        "protocol": "per_call_median + virtual_step_clock",
         "on_tpu": on_tpu,
         "decode_step": decode_rows,
         "serve_load": {
             "n_requests": 12, "slots": 4, "max_len": 128,
             "prefill_chunk": 16, "rows": qps_rows,
         },
+        "equal_hbm": equal_hbm,
+        "prefix_sharing": prefix_sharing,
+        "spec_decode": spec_decode,
+        "pareto": {"step_time_s": STEP_S, "rows": pareto_rows},
     }
 
 
@@ -787,9 +1032,14 @@ def main_sentinel() -> None:
 
 def main_serve() -> None:
     """Driver for ``python bench.py --serve``: prints ONE JSON line, same
-    contract as ``main()``, for the serving comparison."""
+    contract as ``main()``, for the serving tier. ``--smoke`` runs only
+    the cheap dense/paged/spec parity canary (the tier-1 wiring check);
+    the bare ``--serve`` runs the full six-section report including the
+    Pareto sweep (minutes on CPU)."""
+    import sys
+
     on_tpu = jax.devices()[0].platform != "cpu"
-    print(json.dumps(bench_serve(on_tpu)))
+    print(json.dumps(bench_serve(on_tpu, smoke="--smoke" in sys.argv[1:])))
 
 
 def main_zero1() -> None:

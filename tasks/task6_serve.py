@@ -11,12 +11,19 @@ Knobs: ``--cache_kind int8`` for the quantized KV cache, ``--tp N`` to
 shard params + cache heads + the decode step over an N-way
 tensor-parallel mesh (reuses the training TP rules — a TP checkpoint
 serves unmodified), ``--qps inf`` for the saturation (closed-queue)
-regime.
+regime. Multi-tenant levers: ``--paged`` (+ ``--page_size``,
+``--prefix_sharing``) for the page-pool cache layout, ``--spec_k K``
+(+ ``--draft_layers``) for trunk-draft speculative decoding, and
+``--slo_tpot_ms`` for cost-model-priced admission. TP composes with
+dense only — paged/spec under ``--tp`` raise ServeCompositionError by
+contract.
 
 Reports generated tokens/sec and p50/p99 per-token, time-to-first-token,
-and end-to-end request latency.
+and end-to-end request latency, then cross-checks the workload ledger's
+per-request TTFT/TPOT annotations against the raw timing ledger (exact
+accounting).
 
-Run: ``python -m tasks.task6_serve --n_requests 16 --qps 4``
+Run: ``python -m tasks.task6_serve --n_requests 16 --qps 4 --paged``
 """
 
 from __future__ import annotations
@@ -51,6 +58,20 @@ def parse_args(argv=None) -> argparse.Namespace:
                    default="f32")
     p.add_argument("--tp", type=int, default=0,
                    help="tensor-parallel ways (0 = single device)")
+    # multi-tenant levers
+    p.add_argument("--paged", action="store_true",
+                   help="page-pool KV cache layout (serve.paged)")
+    p.add_argument("--page_size", type=int, default=16)
+    p.add_argument("--num_pages", type=int, default=None,
+                   help="pool size (default: dense-equivalent capacity)")
+    p.add_argument("--prefix_sharing", action="store_true",
+                   help="reuse pages across equal prompt heads (paged only)")
+    p.add_argument("--spec_k", type=int, default=0,
+                   help="draft tokens per target step (0 = off)")
+    p.add_argument("--draft_layers", type=int, default=None,
+                   help="trunk-draft depth (default: num_layers // 2)")
+    p.add_argument("--slo_tpot_ms", type=float, default=None,
+                   help="per-token budget for SLO-priced admission")
     # workload
     p.add_argument("--n_requests", type=int, default=16)
     p.add_argument("--qps", type=str, default="4",
@@ -75,9 +96,17 @@ def build_engine(args) -> ServingEngine:
         rope=not args.no_rope,
     )
     params, _ = model.init(jax.random.key(args.seed))
+    slo = None
+    if args.slo_tpot_ms is not None:
+        from tpudml.serve import SLOConfig
+
+        slo = SLOConfig(tpot_budget_s=args.slo_tpot_ms / 1e3)
     cfg = ServeConfig(
         slots=args.slots, max_len=args.max_len,
         prefill_chunk=args.prefill_chunk, cache_kind=args.cache_kind,
+        cache_layout="paged" if args.paged else "dense",
+        page_size=args.page_size, num_pages=args.num_pages,
+        prefix_sharing=args.prefix_sharing, spec_k=args.spec_k, slo=slo,
     )
     if args.tp:
         from tpudml.core.config import MeshConfig
@@ -91,7 +120,8 @@ def build_engine(args) -> ServingEngine:
                          jax.devices()[: args.tp])
         return ServingEngine(model, params, cfg, mesh=mesh,
                              axis_name="model")
-    return ServingEngine(model, params, cfg)
+    return ServingEngine(model, params, cfg,
+                         draft_layers=args.draft_layers)
 
 
 def run(args) -> dict:
@@ -112,6 +142,17 @@ def run(args) -> dict:
     assert report.generated_tokens == owed, (
         f"token accounting mismatch: generated {report.generated_tokens}, "
         f"ledger owes {owed}")
+    # Exact accounting: the ledger's per-request TTFT/TPOT annotations
+    # must replay bit-for-bit from the raw timing ledger.
+    report.annotate_ledger(ledger)
+    for rid, row in ledger.items():
+        st = report.requests[rid]
+        assert row["ttft_s"] == st.first_token - st.arrival, rid
+        if len(st.token_times) >= 2:
+            span = st.token_times[-1] - st.token_times[0]
+            assert row["tpot_s"] == span / (len(st.token_times) - 1), rid
+        else:
+            assert row["tpot_s"] is None, rid
     lat = report.latency_summary()
     writer = MetricsWriter(args.log_dir, run_name="task6-serve")
     writer.add_scalar("Serve Tokens Per Sec", report.tokens_per_sec, 0)
@@ -121,14 +162,26 @@ def run(args) -> dict:
     writer.close()
 
     refills = sum(1 for e in report.events if e[0] == "admit" and e[3] > 0)
+    mode = "".join([
+        "/tp" + str(args.tp) if args.tp else "",
+        "/paged" if args.paged else "",
+        f"/spec{args.spec_k}" if args.spec_k else "",
+    ])
     print(
-        f"[serve{'/tp' + str(args.tp) if args.tp else ''}/"
-        f"{args.cache_kind}] {args.n_requests} requests @ "
+        f"[serve{mode}/{args.cache_kind}] {args.n_requests} requests @ "
         f"qps={args.qps}, {args.slots} slots: "
         f"{report.generated_tokens} tokens in {report.wall_time:.2f}s "
         f"({report.tokens_per_sec:,.1f} tok/s, {report.decode_steps} decode "
         f"steps, {refills} mid-flight refills)"
     )
+    if args.spec_k:
+        print(f"  spec: mean accepted_len "
+              f"{report.mean_accepted_len:.2f} of {args.spec_k} "
+              f"({1 + report.mean_accepted_len:.2f} tokens/target step)")
+    if report.pool_stats is not None:
+        print(f"  pages: {report.pool_stats['prefix_hits']} prefix hits, "
+              f"{report.pool_stats['pages_reused']} pages reused, "
+              f"{report.pool_stats['retained_evictions']} retained evicted")
     print(
         f"  per-token p50/p99: {lat['per_token_p50_s'] * 1e3:.2f}/"
         f"{lat['per_token_p99_s'] * 1e3:.2f} ms | ttft p50/p99: "
@@ -140,6 +193,8 @@ def run(args) -> dict:
         "decode_steps": report.decode_steps,
         "generated_tokens": report.generated_tokens,
         "mid_flight_refills": refills,
+        "mean_accepted_len": report.mean_accepted_len,
+        "pool_stats": report.pool_stats,
         **lat,
     }
 

@@ -1,0 +1,31 @@
+"""J117 firing: a paged-decode-marked step whose attention keys are the
+WHOLE page pool broadcast per token ([num_pages·page_size] = 12 rows)
+instead of the slot's table window — per-token cost scales with total
+HBM provisioned, not one tenant's capacity. The healthy pattern gathers
+``pool[table]`` first (see the silent twin)."""
+
+RULE = "J117"
+EXPECT = "fire"
+
+N, P, H, D, B = 6, 2, 2, 4, 2  # pool rows 12 > any per-slot table window
+
+
+def build():
+    import jax
+    import jax.numpy as jnp
+
+    def _serve_paged_decode_step(pool_k, pool_v, q):
+        # The bug: every slot attends all N·P pool rows.
+        k = jnp.broadcast_to(pool_k.reshape(1, N * P, H, D), (B, N * P, H, D))
+        v = jnp.broadcast_to(pool_v.reshape(1, N * P, H, D), (B, N * P, H, D))
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+    inner = jax.jit(_serve_paged_decode_step)
+    fn = jax.jit(lambda pk, pv, q: inner(pk, pv, q))
+    return fn, (
+        jnp.zeros((N, P, H, D)),
+        jnp.zeros((N, P, H, D)),
+        jnp.zeros((B, 1, H, D)),
+    )

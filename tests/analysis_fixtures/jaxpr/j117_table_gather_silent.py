@@ -1,0 +1,30 @@
+"""J117 silent twin: the same marked decode step reading K/V through the
+slot's page TABLE — ``pool[table]`` gathers max_pages·page_size = 6 rows
+per slot (< the pool's 12), so attention cost tracks per-slot capacity
+and the rule stays quiet."""
+
+RULE = "J117"
+EXPECT = "silent"
+
+N, P, M, H, D, B = 6, 2, 3, 2, 4, 2  # table window 6 rows, pool 12
+
+
+def build():
+    import jax
+    import jax.numpy as jnp
+
+    def _serve_paged_decode_step(pool_k, pool_v, table, q):
+        k = pool_k[table].reshape(B, M * P, H, D)
+        v = pool_v[table].reshape(B, M * P, H, D)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+    inner = jax.jit(_serve_paged_decode_step)
+    fn = jax.jit(lambda pk, pv, tb, q: inner(pk, pv, tb, q))
+    return fn, (
+        jnp.zeros((N, P, H, D)),
+        jnp.zeros((N, P, H, D)),
+        jnp.zeros((B, M), jnp.int32),
+        jnp.zeros((B, 1, H, D)),
+    )

@@ -342,6 +342,46 @@ def test_j110_marker_name_matches_serve_module():
     assert jaxpr_pass.SERVE_DECODE_NAME == engine.SERVE_DECODE_MARKER
 
 
+def test_j117_marker_names_match_serve_modules():
+    """Drift pin for the paged/spec decode markers J117 keys on — and
+    they must NOT collide with the dense marker (the spec window softmax
+    would false-fire J110's single-token contract)."""
+    from tpudml.analysis import jaxpr_pass
+    from tpudml.serve import paged, spec
+
+    assert set(jaxpr_pass.PAGED_DECODE_NAMES) == {
+        paged.PAGED_DECODE_MARKER, spec.SPEC_DECODE_MARKER}
+    assert jaxpr_pass.SERVE_DECODE_NAME not in jaxpr_pass.PAGED_DECODE_NAMES
+
+
+def test_j117_silent_on_real_paged_and_spec_steps():
+    """The shipped paged decode step (table gather) and the paged spec
+    step must trace J117-silent — and J110-silent too, their softmax
+    widths being none of the rule's business under their own markers."""
+    from tpudml.models import TransformerLM
+    from tpudml.serve import ServeConfig, ServingEngine
+
+    lm = TransformerLM(vocab_size=32, embed_dim=16, num_heads=2,
+                       num_layers=2, max_len=16, rope=True)
+    params, _ = lm.init(jax.random.key(0))
+    eng = ServingEngine(
+        lm, params,
+        ServeConfig(slots=2, max_len=16, prefill_chunk=4,
+                    cache_layout="paged", page_size=4, num_pages=9,
+                    spec_k=2))
+    table = np.zeros((2, eng.cfg.max_pages), np.int32)
+    toks = np.zeros(2, np.int32)
+    pos = np.zeros(2, np.int32)
+    plain = analyze_callable(
+        eng._decode, (params, eng.caches, table, toks, pos), "j117-paged")
+    assert [f for f in plain if f.rule in ("J110", "J117")] == [], plain
+    spec = analyze_callable(
+        eng._spec,
+        (params, eng._dparams, eng.caches, eng._dcaches, table, toks, pos),
+        "j117-paged-spec")
+    assert [f for f in spec if f.rule in ("J110", "J117")] == [], spec
+
+
 def test_j100_trace_failure_becomes_finding():
     def broken(x):
         return x + jnp.ones((x.shape[0] + 1,))  # shape mismatch at trace
@@ -367,7 +407,7 @@ def test_donation_parser_reads_aliasing():
 @pytest.mark.parametrize(
     "name",
     ["task2_dp", "dp_zero1", "dp_sentinel", "fsdp", "pp_gpipe", "tp_fused",
-     "fsdp_fused", "moe_ragged", "serve_decode"])
+     "fsdp_fused", "moe_ragged", "serve_decode", "serve_paged_decode"])
 def test_entrypoints_trace_on_cpu(name):
     """The acceptance floor: the DP, FSDP, and pipeline steps trace and
     analyze without TPU hardware, with no error-severity findings and
@@ -475,7 +515,7 @@ def test_jaxpr_fixture_dir_covers_every_dataflow_rule():
     """Each dataflow rule ships a firing seeded-bug fixture AND a silent
     correct-code twin; a deleted fixture file fails here by rule name."""
     names = _jaxpr_fixture_names()
-    for rule in ("j112", "j113", "j114", "j115", "j116"):
+    for rule in ("j112", "j113", "j114", "j115", "j116", "j117"):
         kinds = {n.rsplit("_", 1)[1] for n in names if n.startswith(rule)}
         assert kinds == {"fire", "silent"}, (rule, kinds)
 

@@ -1,0 +1,52 @@
+"""bench.py --serve wiring.
+
+The smoke canary (tier-1) proves the three compiled decode paths —
+dense, paged, paged+spec — emit identical tokens on a seeded workload;
+the slow-marked full report pins the measured wins the serving tier
+claims: paged beats dense at equal HBM on a stranding workload, prefix
+sharing cuts admit→first-token ≥2×, and spec decoding accepts >1 draft
+token per step on the converged-model stand-in.
+"""
+
+import pytest
+
+from bench import bench_serve
+
+
+def test_serve_smoke_canary_parity():
+    out = bench_serve(False, smoke=True)
+    assert out["smoke"] is True
+    assert out["metric"] == "serving_multitenant_parity_smoke"
+    assert out["parity_dense_paged_spec"] is True
+    assert set(out["rows"]) == {"dense", "paged", "paged_spec"}
+    for row in out["rows"].values():
+        assert row["decode_steps"] > 0
+        assert row["tokens_per_step"] > 0
+    assert out["rows"]["paged_spec"]["mean_accepted_len"] >= 0.0
+
+
+@pytest.mark.slow
+def test_serve_full_report_measured_wins():
+    out = bench_serve(False)
+    assert out["metric"] == "serving_multitenant_tier"
+    # (c) equal HBM: dense strands >=50%, paged converts it to tokens.
+    hbm = out["equal_hbm"]
+    assert hbm["rows"]["dense"]["stranded_hbm_frac"] >= 0.5
+    assert hbm["paged_over_dense_tokens_per_step"] > 1.0
+    assert (hbm["rows"]["paged"]["hbm_occupancy"]
+            > hbm["rows"]["dense"]["hbm_occupancy"])
+    assert (hbm["rows"]["paged"]["tokens_per_sec_virtual"]
+            > hbm["rows"]["dense"]["tokens_per_sec_virtual"])
+    # (d) prefix sharing: >=2x admit-to-first-token on repeated heads.
+    assert out["prefix_sharing"]["speedup_admit_to_first_token"] >= 2.0
+    assert out["prefix_sharing"]["pool_stats"]["prefix_hits"] == 5
+    # (e) spec: accepted_len > 1 with exact parity.
+    assert out["spec_decode"]["mean_accepted_len"] > 1.0
+    assert out["spec_decode"]["parity"] is True
+    assert (out["spec_decode"]["decode_steps_spec"]
+            < out["spec_decode"]["decode_steps_dense"])
+    # (f) the full 2x2x2x2 Pareto grid materialized.
+    assert len(out["pareto"]["rows"]) == 16
+    for row in out["pareto"]["rows"].values():
+        assert row["tokens_per_sec_virtual"] > 0
+        assert row["ttft_p50_s"] is not None

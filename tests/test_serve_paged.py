@@ -1,0 +1,424 @@
+"""Paged KV cache tier: pool primitives, the host-side allocator, and
+the engine-level contract that paging is INVISIBLE to the math.
+
+Load-bearing properties:
+
+- pool writes land exactly where the table maps them (flat position =
+  table row × page_size + offset), roundtrip per cache kind, and an
+  inactive slot's all-zero table row sinks its don't-care writes into
+  the reserved garbage page;
+- the allocator is deterministic (min-id free heap, oldest-release-first
+  retained eviction), all-or-nothing, refcount-correct, and loud on
+  double-release;
+- the paged engine reproduces the dense engine's greedy logits at every
+  step to 1e-5/1e-6 and its scheduler event log byte-for-byte at equal
+  capacity — paging changes WHERE bytes live, never what is computed;
+- prefix sharing maps already-resident pages instead of re-prefilling
+  them without perturbing a single output token;
+- SLO admission defers deterministically and never reorders the queue;
+- TP × {paged, spec} rejects loudly (ServeCompositionError), and the
+  full composed stack (paged + spec + overload guard) is byte-
+  deterministic under 2× overload.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpudml.core.config import MeshConfig
+from tpudml.core.dist import make_mesh
+from tpudml.models import TransformerLM
+from tpudml.serve import (
+    PagePool,
+    Request,
+    ServeCompositionError,
+    ServeConfig,
+    ServingEngine,
+    SLOConfig,
+    init_pool,
+    poisson_workload,
+    pool_bytes,
+)
+from tpudml.serve.engine import RequestStats
+from tpudml.serve.paged import (
+    GARBAGE_PAGE,
+    read_row_prefix,
+    read_table,
+    write_chunk,
+    write_tokens,
+)
+from tpudml.serve.sched import DecodeCostModel
+
+V, D, HEADS, LAYERS, MAX_LEN = 48, 32, 4, 2, 32
+RTOL, ATOL = 1e-5, 1e-6
+
+CONFIGS = {
+    "rope_dense": dict(rope=True),
+    "rope_gqa": dict(rope=True, num_kv_heads=2),
+}
+
+
+def _model(**kw):
+    base = dict(vocab_size=V, embed_dim=D, num_heads=HEADS,
+                num_layers=LAYERS, max_len=MAX_LEN)
+    base.update(kw)
+    return TransformerLM(**base)
+
+
+def _prompt(n=11, seed=3):
+    return np.random.default_rng(seed).integers(0, V, n).astype(np.int32)
+
+
+def full_forward_logits(model, params, prompt, steps):
+    """Greedy reference: re-run the FULL forward per emitted token."""
+    toks = list(prompt)
+    logits_seq, out = [], []
+    for _ in range(steps):
+        logits, _ = model.apply(params, {}, jnp.asarray([toks], jnp.int32))
+        row = np.asarray(logits[0, -1])
+        logits_seq.append(row)
+        t = int(np.argmax(row))
+        toks.append(t)
+        out.append(t)
+    return logits_seq, out
+
+
+# ------------------------------------------------------ pool primitives
+
+
+@pytest.mark.parametrize("kind,tol", [("f32", 0.0), ("bf16", 2e-2),
+                                      ("int8", 5e-2)])
+def test_pool_write_read_roundtrip(kind, tol):
+    """write_chunk + write_tokens land K/V at the table-mapped flat
+    positions and read back through read_table/read_row_prefix within
+    the kind's storage tolerance; unmapped pages stay zero."""
+    rng = np.random.default_rng(0)
+    P, M, H, Dh = 4, 3, 2, 8
+    pool = init_pool(6, P, H, Dh, kind)
+    row = np.array([2, 1, 3], np.int32)  # deliberately non-contiguous
+    k_ref = rng.standard_normal((1, M * P, H, Dh)).astype(np.float32)
+    v_ref = rng.standard_normal((1, M * P, H, Dh)).astype(np.float32)
+    # Prefill positions [0, 8) in two chunks, then decode-write 8..9.
+    for s0 in (0, 4):
+        pool = write_chunk(pool, jnp.asarray(k_ref[:, s0:s0 + 4]),
+                           jnp.asarray(v_ref[:, s0:s0 + 4]),
+                           jnp.asarray(row), s0)
+    pool = write_tokens(pool, jnp.asarray(k_ref[:, 8:10]),
+                        jnp.asarray(v_ref[:, 8:10]),
+                        jnp.asarray(row[None, :]),
+                        jnp.asarray([8], jnp.int32))
+    k, v = read_table(pool, jnp.asarray(row[None, :]), jnp.float32)
+    np.testing.assert_allclose(np.asarray(k[0, :10]), k_ref[0, :10],
+                               rtol=0, atol=tol)
+    np.testing.assert_allclose(np.asarray(v[0, :10]), v_ref[0, :10],
+                               rtol=0, atol=tol)
+    pk, pv = read_row_prefix(pool, jnp.asarray(row), 10, jnp.float32)
+    np.testing.assert_array_equal(np.asarray(pk[0]), np.asarray(k[0, :10]))
+    np.testing.assert_array_equal(np.asarray(pv[0]), np.asarray(v[0, :10]))
+    # Pages the table never mapped (0, 4, 5) were never written.
+    for pid in (GARBAGE_PAGE, 4, 5):
+        assert np.all(np.asarray(pool.k[pid]).astype(np.float32) == 0)
+
+
+def test_inactive_slot_writes_sink_to_garbage_page():
+    """An all-zero table row (inactive slot) scatters into page 0 only —
+    live pages can never be corrupted by a don't-care slot."""
+    pool = init_pool(4, 2, 1, 2, "f32")
+    table = jnp.asarray(np.array([[3, 1], [0, 0]], np.int32))
+    k = jnp.ones((2, 1, 1, 2))
+    pool = write_tokens(pool, k, k, table, jnp.asarray([0, 5], jnp.int32))
+    assert np.all(np.asarray(pool.k[3, 0]) == 1)  # live slot landed
+    assert np.any(np.asarray(pool.k[GARBAGE_PAGE]) == 1)  # sink took it
+    assert np.all(np.asarray(pool.k[2]) == 0)  # unmapped page untouched
+
+
+def test_pool_validation_and_bytes():
+    with pytest.raises(ValueError, match="num_pages"):
+        init_pool(1, 4, 2, 8)
+    with pytest.raises(ValueError, match="cache kind"):
+        init_pool(4, 4, 2, 8, "fp4")
+    f32 = init_pool(4, 8, 2, 8, "f32")
+    i8 = init_pool(4, 8, 2, 8, "int8")
+    assert pool_bytes(i8) < pool_bytes(f32) / 2
+
+
+# ------------------------------------------------------------ allocator
+
+
+def test_pagepool_min_id_determinism():
+    pool = PagePool(num_pages=6, page_size=4)
+    assert pool.alloc_n(3) == [1, 2, 3]  # lowest ids first, in order
+    pool.release(2)
+    assert pool.alloc_n(2) == [2, 4]  # freed id re-issued before fresh
+    assert pool.allocated == 4 and pool.available == 1
+
+
+def test_pagepool_alloc_is_all_or_nothing():
+    pool = PagePool(num_pages=4, page_size=4)  # 3 allocatable pages
+    assert pool.alloc_n(2) == [1, 2]
+    before = pool.available
+    assert pool.alloc_n(2) is None  # would need 2, only 1 left
+    assert pool.available == before  # rollback left the pool untouched
+    assert pool.alloc_n(1) == [3]
+    assert pool.alloc_n(0) == []
+
+
+def test_pagepool_release_underflow_raises():
+    pool = PagePool(num_pages=3, page_size=4)
+    (pid,) = pool.alloc_n(1)
+    pool.release(pid)
+    with pytest.raises(RuntimeError, match="released more"):
+        pool.release(pid)
+
+
+def test_pagepool_prefix_retention_and_lru_eviction():
+    """Registered pages survive their last release as retained prefix
+    cache, match future admits, and evict oldest-release-first (keys
+    unregistered) only when the free heap runs dry."""
+    pool = PagePool(num_pages=5, page_size=2, prefix_sharing=True)
+    prompt = np.arange(6, dtype=np.int32)  # p=5: pages 0,1 shareable
+    pages = pool.alloc_n(3)
+    pool.register(pages[0], prompt, 0)
+    pool.register(pages[1], prompt, 1)
+    # First resident writer wins: a duplicate register is a no-op.
+    pool.register(pages[2], prompt, 0)
+    for pid in (pages[2], pages[0], pages[1]):  # release order = LRU order
+        pool.release(pid)
+    assert pool.match_prefix(prompt) == [pages[0], pages[1]]
+    assert pool.prefix_hits == 1 and pool.pages_reused == 2
+    # Matching does NOT take a reference; acquire does.
+    pool.acquire(pages[0])
+    pool.acquire(pages[1])
+    pool.release(pages[0])
+    pool.release(pages[1])
+    # Exhaust: free heap first ([3] and [4]), then retained oldest-first.
+    assert pool.alloc_n(4) == [pages[2], 4, pages[0], pages[1]]
+    assert pool.retained_evictions == 2
+    assert pool.match_prefix(prompt) == []  # keys gone with the pages
+
+
+def test_pagepool_match_stops_before_decode_write_position():
+    """A page reaching the first decode-write position is not matchable
+    — the new request would write into a shared page."""
+    pool = PagePool(num_pages=6, page_size=4, prefix_sharing=True)
+    long_p = np.arange(9, dtype=np.int32)  # p=8: pages 0 AND 1 end before
+    pages = pool.alloc_n(2)
+    pool.register(pages[0], long_p, 0)
+    pool.register(pages[1], long_p, 1)
+    assert pool.match_prefix(long_p) == [pages[0], pages[1]]
+    # Same head, one token shorter: p=7, so page 1 (covering positions
+    # 4..7) now contains the decode-write position and must not match.
+    assert pool.match_prefix(long_p[:8]) == [pages[0]]
+
+
+# ------------------------------------------------------- engine parity
+
+
+@pytest.mark.parametrize("cfg", list(CONFIGS), ids=list(CONFIGS))
+def test_paged_decode_logits_match_full_forward(cfg):
+    """Greedy decode through the page table reproduces the full-forward
+    logits at every emitted position — paging is pure data movement."""
+    model = _model(**CONFIGS[cfg])
+    params, _ = model.init(jax.random.key(0))
+    prompt = _prompt()
+    scfg = ServeConfig(slots=2, max_len=MAX_LEN, prefill_chunk=4,
+                       cache_layout="paged", page_size=4)
+    eng = ServingEngine(model, params, scfg)
+    st = RequestStats(rid=0, prompt_len=len(prompt), max_new_tokens=9,
+                      arrival=0.0)
+    pos0, last0 = eng._admit_paged(
+        0, Request(rid=0, prompt=prompt, max_new_tokens=9), st)
+    ref, toks_ref = full_forward_logits(model, params, prompt, steps=9)
+    pos = np.array([pos0, 0], np.int32)
+    last = np.array([last0, 0], np.int32)
+    for i in range(9):
+        next_t, logits, eng.caches = eng._decode(
+            eng.params, eng.caches, jnp.asarray(eng._table),
+            jnp.asarray(last), jnp.asarray(pos))
+        np.testing.assert_allclose(np.asarray(logits[0]), ref[i],
+                                   rtol=RTOL, atol=ATOL)
+        assert int(next_t[0]) == toks_ref[i]
+        last = np.array([int(next_t[0]), 0], np.int32)
+        pos = pos + np.array([1, 0], np.int32)
+
+
+def test_paged_engine_run_matches_dense_run():
+    """Same seeded workload, equal capacity: the paged engine's token
+    streams AND scheduler event log are identical to the dense engine's
+    — the layout never leaks into scheduling."""
+    model = _model(rope=True, num_kv_heads=2)
+    params, _ = model.init(jax.random.key(1))
+
+    def run(layout):
+        cfg = ServeConfig(slots=3, max_len=MAX_LEN, prefill_chunk=4,
+                          cache_layout=layout, page_size=4)
+        reqs, _ = poisson_workload(8, math.inf, 11, vocab_size=V,
+                                   prompt_len=(2, 10), new_tokens=(3, 6))
+        return ServingEngine(model, params, cfg).run(reqs)
+
+    dense, paged = run("dense"), run("paged")
+    assert dense.events == paged.events
+    assert dense.decode_steps == paged.decode_steps
+    for rid in dense.requests:
+        assert dense.requests[rid].tokens == paged.requests[rid].tokens
+    assert paged.pool_stats == {"prefix_hits": 0, "pages_reused": 0,
+                                "retained_evictions": 0}
+
+
+def test_prefix_sharing_reuses_pages_without_changing_tokens():
+    """Requests with an equal 12-token head map the head's 3 pages from
+    the prefix cache (refcounted, prefill skipped) — and every output
+    token still matches the dense engine exactly."""
+    model = _model(rope=True, num_kv_heads=2)
+    params, _ = model.init(jax.random.key(2))
+    head = _prompt(12, seed=21)
+    reqs = [Request(rid=i, prompt=np.concatenate(
+                [head, _prompt(3, seed=100 + i)]),
+                    max_new_tokens=5, arrival_time=0.0)
+            for i in range(4)]
+
+    shared_cfg = ServeConfig(slots=2, max_len=MAX_LEN, prefill_chunk=4,
+                             cache_layout="paged", page_size=4,
+                             prefix_sharing=True)
+    rep = ServingEngine(model, params, shared_cfg).run(reqs)
+    assert rep.pool_stats["prefix_hits"] == 3  # rids 1..3 hit rid 0's head
+    assert rep.pool_stats["pages_reused"] == 9
+    assert rep.requests[0].shared_pages == 0
+    for rid in (1, 2, 3):
+        assert rep.requests[rid].shared_pages == 3
+
+    dense_cfg = ServeConfig(slots=2, max_len=MAX_LEN, prefill_chunk=4)
+    ref = ServingEngine(model, params, dense_cfg).run(reqs)
+    for rid in ref.requests:
+        assert rep.requests[rid].tokens == ref.requests[rid].tokens
+
+
+# -------------------------------------------------------- SLO admission
+
+
+def test_slo_admission_defers_deterministically():
+    """A budget sized between the 1-active and 2-active step price
+    serializes the engine to one tenant at a time: defers are logged,
+    FIFO order survives, nothing starves, and the run is deterministic."""
+    model = _model(rope=True, num_kv_heads=2)
+    params, _ = model.init(jax.random.key(3))
+    base = ServeConfig(slots=3, max_len=MAX_LEN, prefill_chunk=4,
+                       step_time_s=0.01)
+    probe = DecodeCostModel(model, base, SLOConfig(tpot_budget_s=1.0))
+    budget = (probe.step_seconds(1) + probe.step_seconds(2)) / 2
+    cfg = ServeConfig(slots=3, max_len=MAX_LEN, prefill_chunk=4,
+                      step_time_s=0.01, slo=SLOConfig(tpot_budget_s=budget))
+
+    def once():
+        reqs = [Request(rid=i, prompt=_prompt(6, seed=i),
+                        max_new_tokens=4, arrival_time=0.0)
+                for i in range(4)]
+        return ServingEngine(model, params, cfg).run(reqs)
+
+    rep = once()
+    assert any(e[0] == "defer" for e in rep.events)
+    admitted = [e[1] for e in rep.events if e[0] == "admit"]
+    assert admitted == [0, 1, 2, 3]  # FIFO preserved through deferral
+    live = set()
+    for e in rep.events:
+        if e[0] == "admit":
+            assert not live, "SLO budget admitted a second tenant"
+            live.add(e[1])
+        elif e[0] == "evict":
+            live.remove(e[1])
+    for st in rep.requests.values():
+        assert st.finished is not None and len(st.tokens) == 4
+    rep2 = once()
+    assert rep.events == rep2.events
+
+
+def test_page_starved_admission_defers_then_completes():
+    """A pool too small for two tenants defers the queue head (event
+    logged once) until the running tenant releases its pages; everyone
+    still finishes with exact token counts."""
+    model = _model(rope=True, num_kv_heads=2)
+    params, _ = model.init(jax.random.key(4))
+    # Each request needs ceil((6+4)/4) = 3 pages; pool holds 4.
+    cfg = ServeConfig(slots=2, max_len=MAX_LEN, prefill_chunk=4,
+                      cache_layout="paged", page_size=4, num_pages=5,
+                      step_time_s=0.01)
+    reqs = [Request(rid=i, prompt=_prompt(6, seed=50 + i),
+                    max_new_tokens=4, arrival_time=0.0) for i in range(3)]
+    rep = ServingEngine(model, params, cfg).run(reqs)
+    defers = [e for e in rep.events if e[0] == "defer"]
+    assert defers and len({e[1] for e in defers}) == len(defers)  # deduped
+    for st in rep.requests.values():
+        assert st.finished is not None and len(st.tokens) == 4
+
+
+def test_impossible_page_demand_raises_at_idle():
+    """A request that can NEVER fit the pool raises instead of
+    deadlocking the queue (deferral only makes sense with someone to
+    wait for)."""
+    model = _model(rope=True, num_kv_heads=2)
+    params, _ = model.init(jax.random.key(5))
+    cfg = ServeConfig(slots=1, max_len=MAX_LEN, prefill_chunk=4,
+                      cache_layout="paged", page_size=4, num_pages=3)
+    eng = ServingEngine(model, params, cfg)
+    big = Request(rid=0, prompt=_prompt(20, seed=9), max_new_tokens=8)
+    with pytest.raises(ValueError, match="pool can ever supply"):
+        eng.run([big])
+
+
+# ----------------------------------------------------------- composition
+
+
+def test_tp_rejects_paged_and_spec():
+    mesh = make_mesh(MeshConfig({"model": 2}), jax.devices()[:2])
+    model = _model(rope=True, num_kv_heads=2)
+    params, _ = model.init(jax.random.key(0))
+    paged = ServeConfig(slots=2, max_len=MAX_LEN, prefill_chunk=4,
+                        cache_layout="paged", page_size=4)
+    with pytest.raises(ServeCompositionError, match="paged"):
+        ServingEngine(model, params, paged, mesh=mesh, axis_name="model")
+    spec = ServeConfig(slots=2, max_len=MAX_LEN, prefill_chunk=4, spec_k=2)
+    with pytest.raises(ServeCompositionError, match="spec_k"):
+        ServingEngine(model, params, spec, mesh=mesh, axis_name="model")
+
+
+def test_tpserving_guard_rejects_directly():
+    """The TPServing constructor itself refuses non-dense configs —
+    defense in depth if someone bypasses ServingEngine."""
+    from tpudml.serve.tp import TPServing
+
+    mesh = make_mesh(MeshConfig({"model": 2}), jax.devices()[:2])
+    model = _model(rope=True, num_kv_heads=2)
+    cfg = ServeConfig(slots=2, max_len=MAX_LEN, prefill_chunk=4,
+                      cache_layout="paged", page_size=4)
+    with pytest.raises(ServeCompositionError, match="dense"):
+        TPServing(model, mesh, "model", cfg)
+
+
+# ------------------------------------------------- golden determinism
+
+
+def test_paged_spec_overload_run_is_byte_deterministic():
+    """The fully composed stack — paged cache + speculative decoding +
+    bounded queue at 2× overload on the virtual clock — reproduces a
+    byte-identical event log and token streams across runs."""
+    model = _model(rope=True, num_kv_heads=2)
+    params, _ = model.init(jax.random.key(6))
+    cfg = ServeConfig(slots=1, max_len=MAX_LEN, prefill_chunk=4,
+                      cache_layout="paged", page_size=4, spec_k=2,
+                      max_queue=2, step_time_s=0.01)
+
+    def once():
+        reqs, _ = poisson_workload(10, 40.0, seed=5, vocab_size=V,
+                                   prompt_len=(2, 6), new_tokens=(8, 8))
+        return ServingEngine(model, params, cfg, draft_layers=1).run(reqs)
+
+    a, b = once(), once()
+    assert repr(a.events).encode() == repr(b.events).encode()
+    assert a.decode_steps == b.decode_steps
+    assert a.rejected == b.rejected and a.rejected > 0  # guard engaged
+    for rid in a.requests:
+        assert a.requests[rid].tokens == b.requests[rid].tokens
+    assert any(e[0] == "spec" for e in a.events)

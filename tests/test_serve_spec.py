@@ -1,0 +1,185 @@
+"""Speculative decoding: exactness is the verify step's job.
+
+Load-bearing properties:
+
+- the acceptance rule keeps exactly the longest draft prefix agreeing
+  with the target's greedy argmax and emits the target's correction at
+  the first mismatch (direct ``_verify`` unit);
+- the committed token stream of a spec engine — dense OR paged, with a
+  deliberately weak draft — is EXACTLY the non-spec engine's pure
+  target-greedy stream per request (the whole-point property test);
+- a draft that perfectly agrees with the target accepts all K tokens
+  every step, collapsing decode-step count by ~(K+1)× (the throughput
+  lever, measurable on the event log);
+- ``draft_from_trunk`` returns a true layer-truncated view sharing the
+  embedding/head, and validates its bounds;
+- admission reserves spec_k rows of verify headroom per slot.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpudml.models import TransformerLM
+from tpudml.serve import (
+    Request,
+    ServeConfig,
+    ServingEngine,
+    draft_from_trunk,
+    make_spec_decode_step,
+    poisson_workload,
+)
+from tpudml.serve.spec import _verify
+
+V, D, HEADS, LAYERS, MAX_LEN = 48, 32, 4, 2, 32
+
+
+def _model(**kw):
+    base = dict(vocab_size=V, embed_dim=D, num_heads=HEADS,
+                num_layers=LAYERS, max_len=MAX_LEN, rope=True,
+                num_kv_heads=2)
+    base.update(kw)
+    return TransformerLM(**base)
+
+
+def _onehot_logits(rows):
+    """[B, K+1, V] logits whose argmax per row is the given token."""
+    out = np.zeros((len(rows), len(rows[0]), V), np.float32)
+    for b, toks in enumerate(rows):
+        for j, t in enumerate(toks):
+            out[b, j, t] = 1.0
+    return jnp.asarray(out)
+
+
+# ------------------------------------------------------- verify kernel
+
+
+def test_verify_accepts_longest_agreeing_prefix():
+    """Acceptance stops at the FIRST mismatch even if later draft rows
+    happen to agree again, and the bonus token rides a full match."""
+    window = jnp.asarray([[10, 5, 7, 9],    # drafts 5,7,9
+                          [10, 5, 7, 9],
+                          [10, 5, 7, 9]], jnp.int32)
+    target = [[5, 7, 9, 3],   # all match -> 3 accepted + bonus
+              [5, 8, 9, 3],   # mismatch at d2; d3's "match" is ignored
+              [4, 7, 9, 3]]   # mismatch at d1
+    emitted, n_emit = _verify(window, _onehot_logits(target), spec_k=3)
+    np.testing.assert_array_equal(np.asarray(n_emit), [4, 2, 1])
+    np.testing.assert_array_equal(np.asarray(emitted), target)
+    # Committed tokens = target greedy by construction: row 1 commits
+    # [5, 8] (accepted draft + correction), row 2 commits [4].
+
+
+def test_verify_rejects_all_and_still_emits_one():
+    window = jnp.asarray([[1, 2, 3]], jnp.int32)
+    emitted, n_emit = _verify(window, _onehot_logits([[7, 8, 9]]), spec_k=2)
+    assert int(n_emit[0]) == 1  # progress guarantee: never zero tokens
+    assert int(emitted[0, 0]) == 7
+
+
+# ------------------------------------------------------------ the draft
+
+
+def test_draft_from_trunk_shares_trunk_params():
+    model = _model()
+    params, _ = model.init(jax.random.key(0))
+    draft, dparams = draft_from_trunk(model, params, 1)
+    assert draft.num_layers == 1
+    assert set(dparams) == {"tok_embed", "ln_f", "head", "block0"}
+    assert dparams["block0"] is params["block0"]  # a view, not a copy
+    pos_model = _model(rope=False)
+    pparams, _ = pos_model.init(jax.random.key(0))
+    _, pdparams = draft_from_trunk(pos_model, pparams, 1)
+    assert "pos_embed" in pdparams
+
+
+def test_draft_from_trunk_validates_bounds():
+    model = _model()
+    params, _ = model.init(jax.random.key(0))
+    for bad in (0, LAYERS, LAYERS + 1):
+        with pytest.raises(ValueError, match="draft num_layers"):
+            draft_from_trunk(model, params, bad)
+    with pytest.raises(ValueError, match="spec_k"):
+        make_spec_decode_step(model, model, 0)
+
+
+# --------------------------------------------- exactness property test
+
+
+@pytest.mark.parametrize("layout", ["dense", "paged"])
+def test_spec_stream_equals_pure_target_greedy(layout):
+    """The whole point: with a deliberately WEAK draft (1-layer trunk),
+    every request's committed tokens are exactly what the non-spec
+    engine produces — acceptance quality affects speed, never output."""
+    model = _model()
+    params, _ = model.init(jax.random.key(1))
+    paged_kw = (dict(cache_layout="paged", page_size=4)
+                if layout == "paged" else {})
+
+    def run(spec_k):
+        cfg = ServeConfig(slots=2, max_len=MAX_LEN, prefill_chunk=4,
+                          spec_k=spec_k, **(paged_kw if spec_k else {}))
+        reqs, _ = poisson_workload(6, math.inf, 13, vocab_size=V,
+                                   prompt_len=(2, 8), new_tokens=(4, 7))
+        return ServingEngine(model, params, cfg, draft_layers=1).run(reqs)
+
+    ref, spec = run(0), run(2)
+    for rid in ref.requests:
+        assert spec.requests[rid].tokens == ref.requests[rid].tokens
+    specs = [e for e in spec.events if e[0] == "spec"]
+    assert specs and all(0 <= e[4] <= 2 for e in specs)
+    assert spec.mean_accepted_len >= 0.0
+
+
+def test_perfect_draft_accepts_every_token():
+    """Draft == target: all K drafts match every step, so each spec step
+    commits K+1 tokens and the decode-step count collapses ~3×."""
+    model = _model()
+    params, _ = model.init(jax.random.key(2))
+    reqs = [Request(rid=0, prompt=np.arange(5, dtype=np.int32),
+                    max_new_tokens=9)]
+    cfg = ServeConfig(slots=1, max_len=MAX_LEN, prefill_chunk=4, spec_k=2)
+    eng = ServingEngine(model, params, cfg, draft_model=model,
+                        draft_params=params)
+    rep = eng.run(reqs)
+    assert all(e[4] == 2 for e in rep.events if e[0] == "spec")
+    assert rep.mean_accepted_len == 2.0
+    assert rep.decode_steps == 3  # ceil(9 / (K+1)) target steps, not 9
+    ref_cfg = ServeConfig(slots=1, max_len=MAX_LEN, prefill_chunk=4)
+    ref = ServingEngine(model, params, ref_cfg).run(reqs)
+    assert rep.requests[0].tokens == ref.requests[0].tokens
+
+
+def test_engine_requires_draft_params_with_draft_model():
+    model = _model()
+    params, _ = model.init(jax.random.key(0))
+    cfg = ServeConfig(slots=1, max_len=MAX_LEN, prefill_chunk=4, spec_k=2)
+    with pytest.raises(ValueError, match="draft_params"):
+        ServingEngine(model, params, cfg, draft_model=model)
+
+
+# ---------------------------------------------------------- admission
+
+
+def test_spec_headroom_reserved_at_admission():
+    """prompt + max_new + spec_k must fit max_len: the verify window
+    writes up to spec_k rows past the commit point, and a clamped
+    dynamic_update_slice would silently corrupt the last cache rows."""
+    model = _model()
+    params, _ = model.init(jax.random.key(3))
+    cfg = ServeConfig(slots=1, max_len=MAX_LEN, prefill_chunk=4, spec_k=2)
+    eng = ServingEngine(model, params, cfg, draft_layers=1)
+    fits_dense_only = Request(rid=0, prompt=np.zeros(22, np.int32),
+                              max_new_tokens=9)  # 22+9+2 = 33 > 32
+    with pytest.raises(ValueError, match="verify headroom"):
+        eng.run([fits_dense_only])
+    # The same request is admissible without spec.
+    ref = ServingEngine(model, params,
+                        ServeConfig(slots=1, max_len=MAX_LEN,
+                                    prefill_chunk=4))
+    rep = ref.run([Request(rid=0, prompt=np.zeros(22, np.int32),
+                           max_new_tokens=9)])
+    assert rep.requests[0].finished is not None

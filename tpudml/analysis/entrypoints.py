@@ -28,7 +28,10 @@ cp_ring         parallel/cp.py ContextParallel         task5 --mode cp
 ep_moe          parallel/ep.py ExpertParallel          task5 --mode ep
 lm_bf16         make_train_step on a bf16 LM           task5 --mode single
 serve_decode    serve/engine.py make_decode_step       task6
+serve_paged     serve/engine.py make_paged_decode_step task6 --paged
 ==============  =====================================  ================
+
+(``serve_paged`` is registered as ``serve_paged_decode``.)
 """
 
 from __future__ import annotations
@@ -346,6 +349,36 @@ def build_serve_decode() -> list[Program]:
     )]
 
 
+def build_serve_paged_decode() -> list[Program]:
+    """The paged serving engine's jitted decode step — the surface J117
+    guards. The table-gathering step must trace J117-silent (its softmax
+    keys on max_pages·page_size gathered rows); a step that broadcasts
+    the whole pool per token is the rule's firing fixture (covered in
+    tests/analysis_fixtures/jaxpr/, not registered). ``num_pages`` is
+    chosen strictly above one slot's table (5 > 4) so pool rows and
+    table rows cannot collide shape-wise — the rule's documented
+    detectability bound."""
+    import jax
+    import numpy as np
+    from tpudml.serve import ServeConfig, ServingEngine
+
+    lm = _tiny_lm(rope=True, num_kv_heads=1)
+    params, _ = lm.init(jax.random.key(0))
+    eng = ServingEngine(
+        lm, params,
+        ServeConfig(slots=2, max_len=8, prefill_chunk=4,
+                    cache_layout="paged", page_size=2, num_pages=5),
+    )
+    tokens = np.zeros(2, np.int32)
+    pos = np.zeros(2, np.int32)
+    table = np.zeros((2, eng.cfg.max_pages), np.int32)
+    return [Program(
+        "serve_paged_decode", eng._decode,
+        (params, eng.caches, table, tokens, pos),
+        expects_donation=False,  # donated pool is KiB-scale, like serve_decode
+    )]
+
+
 #: name -> builder; order is reporting order.
 ENTRYPOINTS: dict[str, Callable[[], list[Program]]] = {
     "task1_single": build_task1_single,
@@ -362,6 +395,7 @@ ENTRYPOINTS: dict[str, Callable[[], list[Program]]] = {
     "moe_ragged": build_moe_ragged,
     "lm_bf16": build_lm_bf16,
     "serve_decode": build_serve_decode,
+    "serve_paged_decode": build_serve_paged_decode,
 }
 
 

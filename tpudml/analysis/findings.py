@@ -59,6 +59,9 @@ RULES: dict[str, tuple[str, str]] = {
                    "bytes)"),
     "J116": (WARN, "static peak-live-buffer estimate exceeds the configured "
                    "HBM budget"),
+    "J117": (WARN, "paged-decode-marked program attends over the FULL page "
+                   "pool per token (softmax keyed on num_pages·page_size "
+                   "rows instead of the slot's max_pages table rows)"),
     "A201": (WARN, "Python for/if over a traced (jnp/lax) value"),
     "A202": (WARN, "jax.random key consumed more than once without split"),
     "A203": (WARN, "epoch loop iterates a loader without set_epoch"),
@@ -99,6 +102,10 @@ HINTS: dict[str, str] = {
             "each shard receives exactly the piece it keeps",
     "J116": "shard or rematerialize the largest live buffers, or raise "
             "--hbm_budget if the estimate is for a larger part",
+    "J117": "gather K/V through the slot's page table "
+            "(serve.paged.read_table: pool[table] → [B, max_pages·P, ...]) "
+            "so attention cost scales with per-slot capacity, not pool "
+            "size",
     "A201": "use lax.cond/lax.fori_loop/jnp.where, or materialize with "
             "float(...) first if this is host-side code",
     "A202": "key, sub = jax.random.split(key) before the second use",

@@ -117,6 +117,13 @@ SHARDED_XENT_NAME = "_fused_xent_sharded"
 # literal for the same reason; the pairing is pinned by test_analysis.
 SERVE_DECODE_NAME = "_serve_decode_step"
 
+# Paged/speculative decode steps carry their own marker names (J117) —
+# NOT the dense marker: the spec verify window's [B, H, K+1, L] softmax
+# would false-fire J110's both-trailing-dims>1 check on a single-token
+# contract. Mirror PAGED_DECODE_MARKER (tpudml/serve/paged.py) and
+# SPEC_DECODE_MARKER (tpudml/serve/spec.py); pinned by test_analysis.
+PAGED_DECODE_NAMES = ("_serve_paged_decode_step", "_serve_spec_decode_step")
+
 # Primitives a last-dim sharding survives on the way from a shard_map
 # body invar to the fused head's w operand (J107 taint propagation).
 _LASTDIM_PRESERVING = frozenset({"convert_element_type", "copy"})
@@ -431,6 +438,68 @@ def _check_cacheless_decode(eqn, entrypoint: str,
     ))
 
 
+def _find_pool_wide_exp(obj, pool_rows: frozenset):
+    """First ``exp`` equation (recursing through sub-jaxprs) whose operand's
+    LAST dim equals some pool's total row count — attention scores keyed
+    over every page in the pool instead of one slot's table window."""
+    jaxpr, _ = _inner_jaxpr(obj)
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "exp":
+            shape = tuple(
+                getattr(getattr(eqn.invars[0], "aval", None), "shape", ())
+            )
+            if shape and shape[-1] in pool_rows:
+                return eqn, shape
+        for sub, _extra in _sub_jaxprs(eqn):
+            hit = _find_pool_wide_exp(sub, pool_rows)
+            if hit is not None:
+                return hit
+    return None
+
+
+def _check_full_pool_gather(eqn, entrypoint: str,
+                            findings: list[Finding]) -> None:
+    """J117 for one paged-decode-marked pjit equation: a healthy paged
+    step's softmax is keyed on ``max_pages·page_size`` gathered table
+    rows per slot; keying on ``num_pages·page_size`` (the leading-dims
+    product of a rank-4 pool invar) means the program materializes the
+    WHOLE pool per token — attention cost scaling with total HBM
+    provisioned instead of one tenant's window.
+
+    Detectability bound (documented, like J110's): the pool is
+    identified shape-wise as any rank-4 invar with both leading dims
+    > 1, so the check needs the pool strictly larger than one slot's
+    table (num_pages > max_pages — true of any multi-tenant pool; the
+    registered entrypoint and fixtures guarantee it) and, for spec
+    programs whose DENSE caches are also rank-4, slots >= 2 (else
+    slots·max_len collides with the draft's own max_len softmax width).
+    One finding per marked program."""
+    body = eqn.params.get("jaxpr")
+    if body is None:
+        return
+    jaxpr, _ = _inner_jaxpr(body)
+    pool_rows = set()
+    for iv in jaxpr.invars:
+        shape = tuple(getattr(getattr(iv, "aval", None), "shape", ()))
+        if len(shape) == 4 and shape[0] > 1 and shape[1] > 1:
+            pool_rows.add(shape[0] * shape[1])
+    if not pool_rows:
+        return
+    hit = _find_pool_wide_exp(body, frozenset(pool_rows))
+    if hit is None:
+        return
+    exp_eqn, shape = hit
+    f, ln = _src_loc(exp_eqn)
+    findings.append(Finding(
+        "J117",
+        f"paged decode step attends over the full page pool: softmax exp "
+        f"over {list(shape)} scores whose key dim matches a pool's total "
+        f"rows (num_pages·page_size) — per-token cost scales with pool "
+        f"HBM, not the slot's table window",
+        file=f, line=ln, entrypoint=entrypoint,
+    ))
+
+
 def _scan_update_collectives(obj, axes: tuple[str, ...], acc: dict) -> None:
     """Recursively collect, for J108: the output shapes of tensor psums
     over any of ``axes`` (the allreduced gradients), and whether any
@@ -670,6 +739,8 @@ def _walk(obj, bound: frozenset[str], entrypoint: str,
                 ))
         if name == "pjit" and str(eqn.params.get("name", "")) == SERVE_DECODE_NAME:
             _check_cacheless_decode(eqn, entrypoint, findings)
+        if name == "pjit" and str(eqn.params.get("name", "")) in PAGED_DECODE_NAMES:
+            _check_full_pool_gather(eqn, entrypoint, findings)
         if name == "shard_map":
             seed = _fused_xent_seed(eqn)
             if seed:

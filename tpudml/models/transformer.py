@@ -577,11 +577,38 @@ class TransformerLM(Module):
             for _ in range(self.num_layers)
         )
 
+    def init_paged_cache(self, num_pages: int, page_size: int,
+                         kind: str = "f32"):
+        """Per-layer page pools: a tuple of ``num_layers``
+        ``serve.paged.PagedKVCache`` pytrees, each
+        [num_pages, page_size, kv_heads, head_dim]. The slot→page table
+        lives with the engine, not the pool — every slot reads through
+        its table rows, so pool size is an HBM budget, not a sequence
+        bound (per-slot capacity is the table width × page_size)."""
+        from tpudml.serve.paged import init_pool
+
+        self._serve_guard()
+        head_dim = self.embed_dim // self.num_heads
+        kv_heads = self.num_kv_heads or self.num_heads
+        return tuple(
+            init_pool(num_pages, page_size, kv_heads, head_dim, kind)
+            for _ in range(self.num_layers)
+        )
+
     def _decode_embed(self, params, tokens, pos):
         """[B] tokens at per-slot positions ``pos`` [B] → [B, 1, d]."""
         h = params["tok_embed"][tokens][:, None, :]
         if not self.rope:
             h = h + params["pos_embed"][pos][:, None, :]
+        return h
+
+    def _decode_embed_window(self, params, tokens, pos):
+        """[B, Q] window tokens, first at per-slot positions ``pos`` [B]
+        → [B, Q, d]."""
+        h = params["tok_embed"][tokens]
+        if not self.rope:
+            positions = pos[:, None] + jnp.arange(tokens.shape[1])[None, :]
+            h = h + params["pos_embed"][positions]
         return h
 
     def _serve_blocks(self, params, caches, h, attend):
@@ -617,6 +644,59 @@ class TransformerLM(Module):
         )
         logits = self._head()({k: params[k] for k in ("ln_f", "head")}, h)
         return logits[:, 0, :], new_caches
+
+    def apply_decode_window(self, params, caches, tokens, pos):
+        """Decode a window of Q consecutive tokens per slot over the
+        dense cache: ``tokens`` [B, Q], first token at ``pos`` [B] →
+        (logits [B, Q, V], updated caches). The speculative verify step:
+        one model pass scores all Q positions; greedy acceptance then
+        commits a prefix of them. Q=1 matches apply_decode exactly."""
+        self._serve_guard()
+        params = self._cast_params(params)
+        h = self._decode_embed_window(params, tokens, pos)
+        h, new_caches = self._serve_blocks(
+            params, caches, h,
+            lambda attn, p, cache, y: attn.apply_decode_window(p, cache, y, pos),
+        )
+        logits = self._head()({k: params[k] for k in ("ln_f", "head")}, h)
+        return logits, new_caches
+
+    def apply_decode_paged(self, params, caches, table, tokens, pos):
+        """Decode over paged pools: ``table`` [B, max_pages] maps each
+        slot to its pages, ``tokens`` [B, Q] (Q=1 plain decode, Q=K+1
+        spec verify), ``pos`` [B] → (logits [B, Q, V], updated pools)."""
+        self._serve_guard()
+        params = self._cast_params(params)
+        h = self._decode_embed_window(params, tokens, pos)
+        h, new_caches = self._serve_blocks(
+            params, caches, h,
+            lambda attn, p, pool, y: attn.apply_decode_paged(p, pool, table, y, pos),
+        )
+        logits = self._head()({k: params[k] for k in ("ln_f", "head")}, h)
+        return logits, new_caches
+
+    def apply_prefill_paged(self, params, caches, table_row, chunk, start: int):
+        """Paged prefill of one chunk: ``table_row`` [max_pages] is the
+        admitted slot's page map, ``chunk`` [1, C] tokens at positions
+        [start, start+C) → updated pools. ``start`` static, like the
+        dense path."""
+        self._serve_guard()
+        params = self._cast_params(params)
+        c = chunk.shape[1]
+        h = params["tok_embed"][chunk]
+        if not self.rope:
+            if start + c > self.max_len:
+                raise ValueError(
+                    f"prefill window {start + c} exceeds max_len {self.max_len}"
+                )
+            h = h + params["pos_embed"][start:start + c][None]
+        _, new_caches = self._serve_blocks(
+            params, caches, h,
+            lambda attn, p, pool, y: attn.apply_prefill_paged(
+                p, pool, table_row, y, start
+            ),
+        )
+        return new_caches
 
     def apply_prefill(self, params, caches, chunk, slot, start: int):
         """Prefill one chunk of one slot's prompt: ``chunk`` [1, C]

@@ -122,6 +122,32 @@ def decode_attention(
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
+def decode_attention_window(
+    q: jax.Array, k: jax.Array, v: jax.Array, pos: jax.Array
+) -> jax.Array:
+    """Multi-token decode attention: q [B, Q, H, D] — Q consecutive
+    tokens per slot, the first at per-slot position ``pos`` [B] — over a
+    full cache k/v [B, L, H, D]. The speculative-decoding verify window
+    (Q = K+1) and the paged decode step both land here; Q = 1 reduces
+    exactly to :func:`decode_attention`.
+
+    Query j (global position pos+j) masks ``k_pos <= pos[b] + j``: its
+    own row plus the committed prefix plus the earlier window rows —
+    all written before this call — and NOTHING else. Rows the mask
+    excludes may hold stale K/V from an evicted request; NEG_INF before
+    the f32 softmax gives them exactly zero weight, so they never need
+    zeroing."""
+    d = q.shape[-1]
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    q_pos = pos[:, None] + jnp.arange(q.shape[1])[None, :]  # [B, Q]
+    mask = jnp.arange(k.shape[1])[None, None, :] <= q_pos[:, :, None]
+    s = jnp.where(mask[:, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
 def _chunk_flash_window(
     q: jax.Array, k: jax.Array, v: jax.Array, start: int
 ) -> jax.Array:
@@ -349,6 +375,77 @@ class MultiHeadAttention(Module):
         k, v = self._gqa_repeat(k, v, self.num_heads)
         o = decode_attention(q, k, v, pos).reshape(b, 1, self.embed_dim)
         return o @ params["out"]["kernel"] + params["out"]["bias"], cache
+
+    def apply_decode_window(self, params, cache, x, pos):
+        """Decode a window of Q consecutive tokens per slot: x [B, Q, d]
+        at positions pos..pos+Q-1 (the speculative verify window).
+        Writes all Q rows' K/V, attends each window query over prefix +
+        earlier window rows, returns (out [B, Q, d], updated cache).
+        Rows past the committed count are overwritten by a later window
+        before any unmasked read — the same stale-row invariant the
+        single-token path relies on."""
+        from tpudml.serve.cache import read_all, write_token
+
+        self._serve_guard()
+        b, qlen = x.shape[:2]
+        q, k_new, v_new = self._project(params, x)
+        if self.rope:
+            positions = pos[:, None] + jnp.arange(qlen)[None, :]  # [B, Q]
+            q = rotary_embedding(q, positions, self.rope_base)
+            k_new = rotary_embedding(k_new, positions, self.rope_base)
+        cache = write_token(cache, k_new, v_new, pos)
+        k, v = read_all(cache, x.dtype)
+        k, v = self._gqa_repeat(k, v, self.num_heads)
+        o = decode_attention_window(q, k, v, pos)
+        o = o.reshape(b, qlen, self.embed_dim)
+        return o @ params["out"]["kernel"] + params["out"]["bias"], cache
+
+    def apply_decode_paged(self, params, pool, table, x, pos):
+        """Decode step over a paged pool: x [B, Q, d] (Q=1 plain decode,
+        Q=K+1 spec verify), ``table`` [B, max_pages] each slot's page
+        map, ``pos`` [B]. Same math as apply_decode/apply_decode_window
+        — the gathered table window puts identical values at identical
+        flat positions, and masked rows carry zero weight — so greedy
+        parity vs the dense cache holds bit-for-bit in practice. Returns
+        (out [B, Q, d], updated pool)."""
+        from tpudml.serve.paged import read_table, write_tokens
+
+        self._serve_guard()
+        b, qlen = x.shape[:2]
+        q, k_new, v_new = self._project(params, x)
+        if self.rope:
+            positions = pos[:, None] + jnp.arange(qlen)[None, :]
+            q = rotary_embedding(q, positions, self.rope_base)
+            k_new = rotary_embedding(k_new, positions, self.rope_base)
+        pool = write_tokens(pool, k_new, v_new, table, pos)
+        k, v = read_table(pool, table, x.dtype)
+        k, v = self._gqa_repeat(k, v, self.num_heads)
+        o = decode_attention_window(q, k, v, pos)
+        o = o.reshape(b, qlen, self.embed_dim)
+        return o @ params["out"]["kernel"] + params["out"]["bias"], pool
+
+    def apply_prefill_paged(self, params, pool, table_row, x, start: int):
+        """Prefill one chunk of the slot owning ``table_row``
+        [max_pages]: x [1, C, d] at global positions [start, start+C).
+        Mirrors apply_prefill over the paged pool; ``start`` static."""
+        from tpudml.serve.paged import read_row_prefix, write_chunk
+
+        self._serve_guard()
+        c = x.shape[1]
+        q, k_new, v_new = self._project(params, x)
+        if self.rope:
+            positions = start + jnp.arange(c)
+            q = rotary_embedding(q, positions, self.rope_base)
+            k_new = rotary_embedding(k_new, positions, self.rope_base)
+        pool = write_chunk(pool, k_new, v_new, table_row, start)
+        k, v = read_row_prefix(pool, table_row, start + c, x.dtype)
+        k, v = self._gqa_repeat(k, v, self.num_heads)
+        if jax.default_backend() == "tpu":
+            o = _chunk_flash_window(q, k, v, start)
+        else:
+            o = dot_product_attention(q, k, v, causal=True, q_offset=start)
+        o = o.reshape(1, c, self.embed_dim)
+        return o @ params["out"]["kernel"] + params["out"]["bias"], pool
 
     def apply_prefill(self, params, cache, x, slot, start: int):
         """Prefill one chunk of one slot: x [1, C, d] are features of

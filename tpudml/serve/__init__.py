@@ -1,34 +1,66 @@
-"""tpudml.serve — prefill–decode LM serving with continuous batching.
+"""tpudml.serve — multi-tenant prefill–decode LM serving.
 
-Layers: ``cache`` (preallocated per-layer KV caches, f32/bf16/int8),
-``engine`` (ONE jitted decode step + chunked prefill + slot scheduler),
-``load`` (seeded Poisson request streams), ``tp`` (the same steps under
-shard_map on a tensor-parallel mesh). See docs/API.md §Serving.
+Layers: ``cache`` (dense preallocated per-layer KV caches,
+f32/bf16/int8), ``paged`` (page-pool cache + slot→page table + prefix
+sharing), ``spec`` (speculative decoding with exact greedy
+acceptance-rejection), ``sched`` (SLO-aware admission priced on the
+static cost model), ``engine`` (ONE jitted decode step + chunked
+prefill + slot scheduler composing all of the above), ``load`` (seeded
+Poisson request streams), ``tp`` (the dense steps under shard_map on a
+tensor-parallel mesh; TP × {paged, spec} raises
+ServeCompositionError). See docs/API.md §Serving.
 """
 
 from tpudml.serve.cache import KVCache, cache_bytes, init_cache
 from tpudml.serve.engine import (
     SERVE_DECODE_MARKER,
     RequestStats,
+    ServeCompositionError,
     ServeConfig,
     ServeReport,
     ServingEngine,
     make_cacheless_decode_step,
     make_decode_step,
+    make_paged_decode_step,
 )
 from tpudml.serve.load import Request, poisson_workload
+from tpudml.serve.paged import (
+    PAGED_DECODE_MARKER,
+    PagedKVCache,
+    PagePool,
+    init_pool,
+    pool_bytes,
+)
+from tpudml.serve.sched import DecodeCostModel, SLOConfig
+from tpudml.serve.spec import (
+    SPEC_DECODE_MARKER,
+    draft_from_trunk,
+    make_spec_decode_step,
+)
 
 __all__ = [
     "KVCache",
+    "PAGED_DECODE_MARKER",
+    "PagePool",
+    "PagedKVCache",
     "Request",
     "RequestStats",
     "SERVE_DECODE_MARKER",
+    "SPEC_DECODE_MARKER",
+    "DecodeCostModel",
+    "SLOConfig",
+    "ServeCompositionError",
     "ServeConfig",
     "ServeReport",
     "ServingEngine",
     "cache_bytes",
+    "draft_from_trunk",
     "init_cache",
+    "init_pool",
     "make_cacheless_decode_step",
     "make_decode_step",
+    "make_paged_decode_step",
+    "make_spec_decode_step",
     "poisson_workload",
+    "pool_bytes",
 ]

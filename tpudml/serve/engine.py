@@ -28,10 +28,19 @@ request churn never triggers a recompile.
 Stale cache rows need no zeroing on eviction: a slot's attention mask is
 ``k_pos <= pos``, and every position is written before it is first
 unmasked, so a new occupant can never read its predecessor's K/V.
+
+Three multi-tenant levers compose on top, each flag-gated in
+``ServeConfig`` and each greedy-parity-exact against the dense path:
+``cache_layout="paged"`` (+ ``prefix_sharing``) swaps the cache for a
+page pool behind a slot→page table (serve/paged.py), ``spec_k>0`` swaps
+the decode step for draft-then-verify speculative decoding
+(serve/spec.py), and ``slo`` prices admission with the static cost model
+(serve/sched.py). TP × {paged, spec} raises ServeCompositionError.
 """
 
 from __future__ import annotations
 
+import math
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -42,6 +51,16 @@ import numpy as np
 
 from tpudml.serve.cache import KINDS
 from tpudml.serve.load import Request
+from tpudml.serve.paged import PAGED_DECODE_MARKER, PagePool
+from tpudml.serve.sched import DecodeCostModel, SLOConfig
+from tpudml.serve.spec import draft_from_trunk, make_spec_decode_step
+
+
+class ServeCompositionError(ValueError):
+    """Raised when serving levers are combined in a regime this tier has
+    no correct compiled path for (today: tensor parallelism × paged
+    cache, and tensor parallelism × speculative decoding). Loud by
+    contract — the alternative is a silently wrong answer path."""
 
 # Decode programs are jitted under this NAME so the call survives as a
 # recognizably-named pjit equation in any traced program — the marker
@@ -88,6 +107,31 @@ def make_cacheless_decode_step(model):
     return jax.jit(lambda params, tokens: inner(params, tokens))
 
 
+def make_paged_decode_step(model):
+    """The paged twin of :func:`make_decode_step`: (params, pools,
+    table [B, max_pages], tokens [B], pos [B]) → (next tokens [B],
+    logits [B, V], updated pools). The table is an ordinary traced
+    argument — page alloc/free between steps never recompiles — and the
+    pools are donated. Jitted under its OWN marker name so analysis
+    rule J117 (full-pool gather per token) can key on exactly the
+    programs that read through a page table."""
+
+    def _serve_paged_decode_step(params, caches, table, tokens, pos):
+        logits, caches = model.apply_decode_paged(
+            params, caches, table, tokens[:, None], pos
+        )
+        logits = logits[:, 0, :]
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), logits, caches
+
+    assert _serve_paged_decode_step.__name__ == PAGED_DECODE_MARKER
+    inner = jax.jit(_serve_paged_decode_step)
+
+    def step(params, caches, table, tokens, pos):
+        return inner(params, caches, table, tokens, pos)
+
+    return jax.jit(step, donate_argnums=(1,))
+
+
 @dataclass(frozen=True)
 class ServeConfig:
     """Engine shape knobs (all static — they size the compiled programs)."""
@@ -115,6 +159,31 @@ class ServeConfig:
     # become a pure function of (workload seed, config) — the regime the
     # overload tests pin bit-for-bit.
     step_time_s: float | None = None
+    # Cache layout. "dense" is the PR 8 [slots, max_len] block; "paged"
+    # stores K/V in a fixed pool of [num_pages, page_size, ...] pages
+    # addressed through a [slots, max_pages] table (serve/paged.py) —
+    # max_len still bounds prompt + generation per request, but HBM is
+    # sized by ``num_pages``, so short requests stop stranding long
+    # requests' headroom. ``num_pages=None`` sizes the pool to dense
+    # capacity + the garbage page (a pure-layout A/B at equal HBM).
+    cache_layout: str = "dense"
+    page_size: int = 16
+    num_pages: int | None = None
+    # Prefix sharing (paged only): admit-time page reuse for equal
+    # prompt heads, refcounted, copy-on-write at the first divergent
+    # page. Requires page_size % prefill_chunk == 0 so a shared head
+    # always ends on a prefill-chunk boundary.
+    prefix_sharing: bool = False
+    # Speculative decoding: draft spec_k tokens per target step, exact
+    # greedy acceptance-rejection (serve/spec.py). 0 disables. Admission
+    # reserves spec_k rows of headroom per slot (the verify window
+    # writes up to spec_k rows past the commit point).
+    spec_k: int = 0
+    # SLO-aware admission: with an SLOConfig set, the queue head is
+    # admitted only while the priced decode step (serve/sched.py) fits
+    # the per-token budget; otherwise it waits (event
+    # ``("defer", rid, -1, step)`` on first deferral).
+    slo: SLOConfig | None = None
 
     def __post_init__(self):
         if self.slots < 1:
@@ -132,6 +201,43 @@ class ServeConfig:
             raise ValueError("deadline_s must be > 0 (or None)")
         if self.step_time_s is not None and self.step_time_s <= 0:
             raise ValueError("step_time_s must be > 0 (or None)")
+        if self.cache_layout not in ("dense", "paged"):
+            raise ValueError(
+                f"cache_layout must be 'dense' or 'paged', "
+                f"got {self.cache_layout!r}"
+            )
+        if self.cache_layout == "paged":
+            if self.page_size < 1:
+                raise ValueError("page_size must be >= 1")
+            if self.num_pages is not None and self.num_pages < 2:
+                raise ValueError(
+                    "num_pages must be >= 2 (page 0 is the garbage sink)"
+                )
+            if self.prefix_sharing and self.page_size % self.prefill_chunk:
+                raise ValueError(
+                    f"prefix_sharing requires page_size "
+                    f"{self.page_size} to be a multiple of prefill_chunk "
+                    f"{self.prefill_chunk} (a shared head must end on a "
+                    f"chunk boundary so fresh prefill never rewrites a "
+                    f"shared page)"
+                )
+        elif self.prefix_sharing:
+            raise ValueError("prefix_sharing requires cache_layout='paged'")
+        if self.spec_k < 0:
+            raise ValueError("spec_k must be >= 0")
+
+    @property
+    def max_pages(self) -> int:
+        """Page-table width: pages covering one slot's max_len rows."""
+        return math.ceil(self.max_len / self.page_size)
+
+    @property
+    def total_pages(self) -> int:
+        """Pool size: ``num_pages``, defaulting to dense-equivalent
+        capacity (slots × max_pages) plus the reserved garbage page."""
+        if self.num_pages is not None:
+            return self.num_pages
+        return self.slots * self.max_pages + 1
 
 
 @dataclass
@@ -143,6 +249,7 @@ class RequestStats:
     prompt_len: int
     max_new_tokens: int
     arrival: float
+    admit_start: float | None = None  # admission began (prefill starts)
     admitted: float | None = None  # prefill finished, slot occupied
     first_token: float | None = None
     finished: float | None = None
@@ -151,6 +258,24 @@ class RequestStats:
     slot: int | None = None
     tokens: list = field(default_factory=list)
     token_times: list = field(default_factory=list)
+    shared_pages: int = 0  # prefix-cache pages reused at admit (paged)
+
+    @property
+    def ttft_s(self) -> float | None:
+        """Time-to-first-token: arrival → first generated token."""
+        if self.first_token is None:
+            return None
+        return self.first_token - self.arrival
+
+    @property
+    def tpot_s(self) -> float | None:
+        """Mean time-per-output-token AFTER the first (decode cadence);
+        None until a request has at least two tokens."""
+        if len(self.token_times) < 2:
+            return None
+        return (self.token_times[-1] - self.token_times[0]) / (
+            len(self.token_times) - 1
+        )
 
 
 @dataclass
@@ -159,14 +284,45 @@ class ServeReport:
     (admit/evict tuples — the determinism contract), and aggregates."""
 
     requests: dict
-    events: list  # ("admit"|"evict"|"reject"|"expire", rid, slot, step)
+    # ("admit"|"evict"|"reject"|"expire"|"defer", rid, slot, step) plus
+    # ("spec", rid, slot, step, accepted_len) when spec decoding is on.
+    events: list
     decode_steps: int
     wall_time: float
     peak_queue_depth: int = 0  # max waiting-line length ever observed
+    busy_slot_steps: int = 0  # Σ over steps of active-slot count
+    slots: int = 0  # engine slot count (occupancy denominator)
+    pool_stats: dict | None = None  # paged only: prefix hits/evictions
 
     @property
     def generated_tokens(self) -> int:
         return sum(len(s.tokens) for s in self.requests.values())
+
+    @property
+    def occupancy(self) -> float:
+        """Mean fraction of decode-slot-steps doing useful work — the
+        number a paged layout raises on mixed short/long traffic (dense
+        strands capacity as queued work waits for whole max_len rows)."""
+        denom = self.decode_steps * max(self.slots, 1)
+        return self.busy_slot_steps / denom if denom else 0.0
+
+    @property
+    def mean_accepted_len(self) -> float:
+        """Mean accepted draft tokens per spec step (0.0 without spec
+        events); tokens-per-target-step is ``1 + mean_accepted_len``."""
+        ls = [e[4] for e in self.events if e[0] == "spec"]
+        return float(np.mean(ls)) if ls else 0.0
+
+    def annotate_ledger(self, ledger: dict[int, dict]) -> dict[int, dict]:
+        """Fill the workload ledger's per-request ``ttft_s``/``tpot_s``
+        fields (serve/load.py creates them as None) from this run's
+        stats, in place."""
+        for rid, row in ledger.items():
+            st = self.requests.get(rid)
+            if st is not None:
+                row["ttft_s"] = st.ttft_s
+                row["tpot_s"] = st.tpot_s
+        return ledger
 
     @property
     def rejected(self) -> int:
@@ -219,13 +375,27 @@ class ServingEngine:
     """
 
     def __init__(self, model, params, config: ServeConfig | None = None,
-                 *, mesh=None, axis_name: str = "model"):
+                 *, mesh=None, axis_name: str = "model",
+                 draft_model=None, draft_params=None,
+                 draft_layers: int | None = None):
         self.model = model
         self.cfg = config or ServeConfig()
-        if not model.rope and self.cfg.max_len > model.max_len:
+        cfg = self.cfg
+        if not model.rope and cfg.max_len > model.max_len:
             raise ValueError(
-                f"cache max_len {self.cfg.max_len} exceeds the position "
+                f"cache max_len {cfg.max_len} exceeds the position "
                 f"table ({model.max_len}); only RoPE models extrapolate"
+            )
+        self._paged = cfg.cache_layout == "paged"
+        if mesh is not None and (self._paged or cfg.spec_k):
+            # The TP decode step shards cache heads through a shard_map
+            # body that knows nothing of page tables or verify windows.
+            # Until those bodies exist, composing would silently run the
+            # unsharded math on sharded params — reject instead.
+            raise ServeCompositionError(
+                "tensor-parallel serving does not compose with "
+                "cache_layout='paged' or spec_k>0 yet; run TP dense, or "
+                "paged/spec single-device"
             )
         self._tp = None
         if mesh is not None:
@@ -239,12 +409,58 @@ class ServingEngine:
             self._prefill_builder = self._tp.prefill_at
         else:
             self.params = params
-            self.caches = model.init_decode_cache(
-                self.cfg.slots, self.cfg.max_len, self.cfg.cache_kind
-            )
-            self._decode = make_decode_step(model)
+            if self._paged:
+                self.caches = model.init_paged_cache(
+                    cfg.total_pages, cfg.page_size, cfg.cache_kind
+                )
+                self._decode = make_paged_decode_step(model)
+                self._prefill_builder = self._build_prefill_paged
+            else:
+                self.caches = model.init_decode_cache(
+                    cfg.slots, cfg.max_len, cfg.cache_kind
+                )
+                self._decode = make_decode_step(model)
+                self._prefill_builder = self._build_prefill
             self._prefill_cache = {}
-            self._prefill_builder = self._build_prefill
+        # Paged bookkeeping: the host-side allocator plus the
+        # [slots, max_pages] table the decode step reads through.
+        self._pool = None
+        self._table = None
+        self._slot_pages: list[list[int]] = [[] for _ in range(cfg.slots)]
+        if self._paged:
+            self._pool = PagePool(
+                cfg.total_pages, cfg.page_size, cfg.prefix_sharing
+            )
+            self._table = np.zeros((cfg.slots, cfg.max_pages), np.int32)
+        # Speculative decoding: default draft is the target's lower
+        # trunk (zero extra weights); exactness never depends on it.
+        self._spec = None
+        self.draft_model = None
+        if cfg.spec_k:
+            if draft_model is None:
+                n = draft_layers or max(1, model.num_layers // 2)
+                draft_model, draft_params = draft_from_trunk(model, params, n)
+            elif draft_params is None:
+                raise ValueError("draft_model requires draft_params")
+            self.draft_model = draft_model
+            self._dparams = draft_params
+            # The draft cache stays dense in every mode — it is small by
+            # construction and only ever single-token-stepped.
+            self._dcaches = draft_model.init_decode_cache(
+                cfg.slots, cfg.max_len, cfg.cache_kind
+            )
+            self._dprefill_cache = {}
+            self._spec = make_spec_decode_step(
+                model, draft_model, cfg.spec_k, paged=self._paged
+            )
+        # SLO admission pricing (deterministic, host-side).
+        self._cost = None
+        if cfg.slo is not None:
+            self._cost = DecodeCostModel(
+                model, cfg, cfg.slo,
+                world=self._tp.world if self._tp is not None else 1,
+                draft_model=self.draft_model,
+            )
 
     # ------------------------------------------------------------ prefill
 
@@ -256,27 +472,77 @@ class ServingEngine:
 
         return jax.jit(_serve_prefill_chunk, donate_argnums=(1,))
 
+    def _build_prefill_paged(self, start: int):
+        model = self.model
+
+        def _serve_prefill_chunk(params, caches, chunk, table_row):
+            return model.apply_prefill_paged(params, caches, table_row,
+                                             chunk, start)
+
+        return jax.jit(_serve_prefill_chunk, donate_argnums=(1,))
+
     def _prefill_at(self, start: int):
         fn = self._prefill_cache.get(start)
         if fn is None:
             fn = self._prefill_cache[start] = self._prefill_builder(start)
         return fn
 
+    def _build_prefill_draft(self, start: int):
+        draft = self.draft_model
+
+        def _serve_prefill_chunk(dparams, dcaches, chunk, slot):
+            return draft.apply_prefill(dparams, dcaches, chunk, slot, start)
+
+        return jax.jit(_serve_prefill_chunk, donate_argnums=(1,))
+
+    def _prefill_draft(self, slot: int, prompt: np.ndarray) -> None:
+        """Spec only: the DRAFT cache needs the prompt too — a draft
+        proposing from an unprefilled history is pure noise, zeroing
+        acceptance (exactness never cared, throughput very much did).
+        It is per-slot dense and never shares prefix pages, so the whole
+        head is prefilled even when the target's pages were shared."""
+        if self._spec is None:
+            return
+        p = prompt.size - 1
+        c = self.cfg.prefill_chunk
+        slot_j = jnp.asarray(slot, jnp.int32)
+        for s0 in range(0, p, c):
+            chunk = np.zeros((1, c), np.int32)
+            n = min(c, p - s0)
+            chunk[0, :n] = prompt[s0:s0 + n]
+            fn = self._dprefill_cache.get(s0)
+            if fn is None:
+                fn = self._dprefill_cache[s0] = self._build_prefill_draft(s0)
+            self._dcaches = fn(
+                self._dparams, self._dcaches, jnp.asarray(chunk), slot_j
+            )
+
+    def _spec_headroom(self) -> int:
+        return self.cfg.spec_k if self._spec is not None else 0
+
+    def _validate_request(self, req: Request) -> np.ndarray:
+        prompt = np.asarray(req.prompt, np.int32)
+        if prompt.ndim != 1 or prompt.size < 1:
+            raise ValueError(f"request {req.rid}: prompt must be [L>=1]")
+        total = prompt.size + req.max_new_tokens + self._spec_headroom()
+        if total > self.cfg.max_len:
+            extra = (
+                f" (+ spec_k {self.cfg.spec_k} verify headroom)"
+                if self._spec_headroom() else ""
+            )
+            raise ValueError(
+                f"request {req.rid}: prompt {prompt.size} + "
+                f"max_new_tokens {req.max_new_tokens}{extra} exceeds "
+                f"cache max_len {self.cfg.max_len}"
+            )
+        return prompt
+
     def _admit(self, slot: int, req: Request) -> tuple[int, int]:
         """Prefill ``req``'s prompt (all but the last token) into a
         slot's cache rows; returns (pos, last_token) for the decode
         state. Chunk tails are padded — padded rows land at positions
         the mask excludes until decode overwrites them."""
-        prompt = np.asarray(req.prompt, np.int32)
-        if prompt.ndim != 1 or prompt.size < 1:
-            raise ValueError(f"request {req.rid}: prompt must be [L>=1]")
-        total = prompt.size + req.max_new_tokens
-        if total > self.cfg.max_len:
-            raise ValueError(
-                f"request {req.rid}: prompt {prompt.size} + "
-                f"max_new_tokens {req.max_new_tokens} exceeds cache "
-                f"max_len {self.cfg.max_len}"
-            )
+        prompt = self._validate_request(req)
         p = prompt.size - 1
         c = self.cfg.prefill_chunk
         slot_j = jnp.asarray(slot, jnp.int32)
@@ -287,7 +553,67 @@ class ServingEngine:
             self.caches = self._prefill_at(s0)(
                 self.params, self.caches, jnp.asarray(chunk), slot_j
             )
+        self._prefill_draft(slot, prompt)
         return p, int(prompt[-1])
+
+    def _admit_paged(self, slot: int, req: Request,
+                     stats: RequestStats) -> tuple[int, int] | None:
+        """Paged admission: map pages into the slot's table row — prefix
+        hits first (refcounted, skipping their prefill entirely), fresh
+        pages for the rest — then prefill from the first unshared
+        position. Returns None (leaving the pool untouched and the
+        request queued) when the pool cannot supply the fresh pages; the
+        caller defers FIFO-preservingly."""
+        cfg = self.cfg
+        prompt = self._validate_request(req)
+        total = prompt.size + req.max_new_tokens + self._spec_headroom()
+        p = prompt.size - 1
+        pool = self._pool
+        needed = math.ceil(total / cfg.page_size)
+        shared = pool.match_prefix(prompt)  # only pages ending before p
+        fresh = pool.alloc_n(needed - len(shared))
+        if fresh is None:
+            return None
+        for pid in shared:
+            pool.acquire(pid)
+        pages = shared + fresh
+        row = np.zeros(cfg.max_pages, np.int32)
+        row[: len(pages)] = pages
+        self._table[slot] = row
+        self._slot_pages[slot] = pages
+        stats.shared_pages = len(shared)
+        # Prefill [n_shared·P, p) — a chunk-aligned start by the
+        # page_size % prefill_chunk == 0 config rule, so a fresh chunk
+        # never writes into a shared page.
+        c = cfg.prefill_chunk
+        row_j = jnp.asarray(row)
+        for s0 in range(len(shared) * cfg.page_size, p, c):
+            chunk = np.zeros((1, c), np.int32)
+            n = min(c, p - s0)
+            chunk[0, :n] = prompt[s0:s0 + n]
+            self.caches = self._prefill_at(s0)(
+                self.params, self.caches, jnp.asarray(chunk), row_j
+            )
+        if pool.prefix_sharing:
+            # Publish this request's fully-prefilled fresh pages: page j
+            # is shareable iff it ends strictly before the first decode
+            # write at p, so no future occupant ever writes it.
+            for j in range(len(shared), len(pages)):
+                if (j + 1) * cfg.page_size <= p:
+                    pool.register(pages[j], prompt, j)
+        self._prefill_draft(slot, prompt)
+        return p, int(prompt[-1])
+
+    def _release_slot(self, slot: int) -> None:
+        """Return a finished/expired slot's pages to the allocator and
+        zero its table row (pointing future don't-care writes at the
+        garbage page)."""
+        if self._pool is None:
+            return
+        for pid in self._slot_pages[slot]:
+            self._pool.release(pid)
+        self._slot_pages[slot] = []
+        self._table[slot] = 0
 
     # ---------------------------------------------------------------- run
 
@@ -324,6 +650,8 @@ class ServingEngine:
         events: list = []
         steps = 0
         peak_queue = 0
+        busy_slot_steps = 0
+        deferred_logged: set[int] = set()  # one "defer" event per rid
         # Clock: wall time by default; virtual (decode-step-derived) when
         # cfg.step_time_s is set — see ServeConfig.
         t0 = time.perf_counter()
@@ -358,11 +686,41 @@ class ServingEngine:
                         kept.append(req)
                 queue = kept
             # Admit: free slots in index order, queue in arrival order.
+            # The head is only ever PEEKED until admission succeeds —
+            # an SLO deferral or a page-starved pool leaves it queued,
+            # and nothing behind it may overtake (FIFO + (arrival, rid)
+            # order is the determinism contract).
             for i in range(b):
                 if active[i] or not queue:
                     continue
-                req = queue.popleft()
-                pos[i], last[i] = self._admit(i, req)
+                req = queue[0]
+                if self._cost is not None and not self._cost.admit_ok(
+                    int(active.sum())
+                ):
+                    if req.rid not in deferred_logged:
+                        deferred_logged.add(req.rid)
+                        events.append(("defer", req.rid, -1, steps))
+                    break
+                st = stats[req.rid]
+                st.admit_start = now()
+                if self._paged:
+                    admitted = self._admit_paged(i, req, st)
+                    if admitted is None:
+                        if not active.any():
+                            raise ValueError(
+                                f"request {req.rid} needs more pages "
+                                f"than the pool can ever supply "
+                                f"({cfg.total_pages} pages incl. the "
+                                f"garbage page)"
+                            )
+                        if req.rid not in deferred_logged:
+                            deferred_logged.add(req.rid)
+                            events.append(("defer", req.rid, -1, steps))
+                        break
+                else:
+                    admitted = self._admit(i, req)
+                queue.popleft()
+                pos[i], last[i] = admitted
                 remaining[i] = req.max_new_tokens
                 slot_rid[i] = req.rid
                 slot_deadline[i] = (
@@ -371,7 +729,6 @@ class ServingEngine:
                     else np.inf
                 )
                 active[i] = True
-                st = stats[req.rid]
                 st.admitted = now()
                 st.slot = i
                 events.append(("admit", req.rid, i, steps))
@@ -387,33 +744,69 @@ class ServingEngine:
                 continue
             # One decode step for ALL slots. Inactive slots run garbage
             # tokens at stale positions — harmless by the mask argument
-            # in the module docstring — so the compiled shape never
-            # changes with occupancy.
-            next_t, _, self.caches = self._decode(
-                self.params, self.caches, jnp.asarray(last), jnp.asarray(pos)
-            )
-            next_np = np.asarray(jax.device_get(next_t))
+            # in the module docstring (paged: their zero table rows point
+            # every write at the garbage page) — so the compiled shape
+            # never changes with occupancy. Spec steps return a K+1-wide
+            # window + per-slot commit counts; plain steps reduce to the
+            # same contract at width 1.
+            busy_slot_steps += int(active.sum())
+            last_j, pos_j = jnp.asarray(last), jnp.asarray(pos)
+            if self._spec is not None:
+                if self._paged:
+                    emitted, n_emit, _, self.caches, self._dcaches = (
+                        self._spec(self.params, self._dparams, self.caches,
+                                   self._dcaches, jnp.asarray(self._table),
+                                   last_j, pos_j)
+                    )
+                else:
+                    emitted, n_emit, _, self.caches, self._dcaches = (
+                        self._spec(self.params, self._dparams, self.caches,
+                                   self._dcaches, last_j, pos_j)
+                    )
+                emitted_np = np.asarray(jax.device_get(emitted))
+                n_emit_np = np.asarray(jax.device_get(n_emit))
+            else:
+                if self._paged:
+                    next_t, _, self.caches = self._decode(
+                        self.params, self.caches, jnp.asarray(self._table),
+                        last_j, pos_j,
+                    )
+                else:
+                    next_t, _, self.caches = self._decode(
+                        self.params, self.caches, last_j, pos_j
+                    )
+                emitted_np = np.asarray(jax.device_get(next_t))[:, None]
+                n_emit_np = np.ones(b, np.int64)
             steps += 1
             t_step = now()
             for i in range(b):
                 if not active[i]:
                     continue
-                tok = int(next_np[i])
                 st = stats[slot_rid[i]]
-                st.tokens.append(tok)
-                st.token_times.append(t_step)
-                if st.first_token is None:
-                    st.first_token = t_step
-                pos[i] += 1
-                last[i] = tok
-                remaining[i] -= 1
-                if remaining[i] <= 0 or (
-                    cfg.eos_token is not None and tok == cfg.eos_token
-                ):
+                if self._spec is not None:
+                    events.append(("spec", int(slot_rid[i]), i, steps,
+                                   int(n_emit_np[i]) - 1))
+                done = False
+                for tok in emitted_np[i, : int(n_emit_np[i])]:
+                    tok = int(tok)
+                    st.tokens.append(tok)
+                    st.token_times.append(t_step)
+                    if st.first_token is None:
+                        st.first_token = t_step
+                    pos[i] += 1
+                    last[i] = tok
+                    remaining[i] -= 1
+                    if remaining[i] <= 0 or (
+                        cfg.eos_token is not None and tok == cfg.eos_token
+                    ):
+                        done = True
+                        break
+                if done:
                     st.finished = t_step
                     active[i] = False
                     events.append(("evict", int(slot_rid[i]), i, steps))
                     slot_rid[i] = -1
+                    self._release_slot(i)
                 elif t_step > slot_deadline[i]:
                     # Mid-flight deadline eviction at the step boundary:
                     # the slot frees for the queue head, the partial
@@ -422,7 +815,16 @@ class ServingEngine:
                     active[i] = False
                     events.append(("expire", int(slot_rid[i]), i, steps))
                     slot_rid[i] = -1
+                    self._release_slot(i)
+        pool_stats = None
+        if self._pool is not None:
+            pool_stats = {
+                "prefix_hits": self._pool.prefix_hits,
+                "pages_reused": self._pool.pages_reused,
+                "retained_evictions": self._pool.retained_evictions,
+            }
         return ServeReport(
             requests=stats, events=events, decode_steps=steps,
             wall_time=now(), peak_queue_depth=peak_queue,
+            busy_slot_steps=busy_slot_steps, slots=b, pool_stats=pool_stats,
         )

@@ -70,5 +70,11 @@ def poisson_workload(
             "prompt_len": int(plens[i]),
             "max_new_tokens": int(olens[i]),
             "arrival_time": float(arrivals[i]),
+            # Per-request latency outcomes, filled by
+            # ServeReport.annotate_ledger after a run (None = the
+            # request never reached that milestone). Previously these
+            # were derivable only by replaying the event log.
+            "ttft_s": None,
+            "tpot_s": None,
         }
     return requests, ledger

@@ -1,0 +1,330 @@
+"""Paged KV cache: a fixed page pool + slot→page table, with prefix
+sharing.
+
+The dense cache (``serve.cache``) reserves ``max_len`` rows per slot, so
+one long request strands HBM that could hold many short ones. Here the
+HBM is a single pool of ``[num_pages, page_size, kv_heads, head_dim]``
+pages per layer, and each slot maps at most ``max_pages`` of them
+through a static-shape ``[slots, max_pages]`` int32 page table that is
+an ordinary traced argument of the ONE jitted decode step:
+
+- **read**: gather the slot's table rows from the pool
+  (``pool[table] → [slots, max_pages, page_size, ...]``), flatten to a
+  ``[slots, max_pages·page_size]`` key window, and mask by the flat
+  position exactly like the dense path (``k_pos <= pos``). Attention
+  cost scales with per-slot capacity, never with pool size — a decode
+  step that instead materializes the whole pool per token is what
+  analysis rule J117 flags.
+- **write**: scatter the step's new K/V rows to
+  ``(table[b, pos//P], pos % P)``. Page 0 is a reserved garbage sink:
+  inactive slots carry an all-zero table row, so their don't-care writes
+  land there and can never corrupt a live request's pages.
+- **alloc/free** is host-side scheduler bookkeeping between steps
+  (``PagePool``), so the compiled program never changes shape with
+  occupancy, and the pool tensors are donated every step like the dense
+  cache.
+
+**Prefix sharing** (copy-on-write at page granularity): at admit time
+the scheduler hashes the prompt head page-by-page (the key for page j is
+the first ``(j+1)·page_size`` prompt tokens — K/V at a position depend
+only on the tokens up to it, so equal heads mean bitwise-equal pages)
+and maps any already-resident pages into the new slot's table with a
+refcount bump instead of re-prefilling them. Only pages that end
+strictly before the first decode-write position are ever registered, so
+a shared page is written exactly once in its life — the "copy" of
+copy-on-write is the fresh prefill of the first divergent page, and no
+device-side copy primitive is needed. Pages whose refcount drops to
+zero but that still carry a prefix key are RETAINED (not freed) in LRU
+order, so identical system prompts hit across requests over time; the
+allocator evicts retained pages deterministically (oldest release
+first) only under pool pressure.
+"""
+
+from __future__ import annotations
+
+import heapq
+from collections import OrderedDict
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpudml.serve.cache import KINDS, _dequant, _encode
+
+# The paged decode step is jitted under this NAME (serve/engine.py) so
+# analysis rule J117 can key on it — mirrored as a literal in
+# tpudml/analysis/jaxpr_pass.py (pinned by test_serve_paged).
+PAGED_DECODE_MARKER = "_serve_paged_decode_step"
+
+#: Page 0 is never allocated: it is the scatter sink for inactive slots'
+#: don't-care writes (their table rows are all zeros).
+GARBAGE_PAGE = 0
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class PagedKVCache:
+    """One layer's page pool: K/V pages plus (int8 only) per-(page, row,
+    head) scales. Distinct buffers per field — the engine donates the
+    pool pytree every step and XLA rejects double-donation."""
+
+    k: jax.Array  # [N, P, Hkv, Dh] storage dtype
+    v: jax.Array
+    k_scale: jax.Array  # [N, P, Hkv] f32; zeros-shaped [0] when unused
+    v_scale: jax.Array
+    kind: str = field(metadata=dict(static=True))
+
+    @property
+    def num_pages(self) -> int:
+        return self.k.shape[0]
+
+    @property
+    def page_size(self) -> int:
+        return self.k.shape[1]
+
+
+def _store_dtype(kind: str):
+    return {
+        "f32": jnp.float32, "bf16": jnp.bfloat16, "int8": jnp.int8,
+        "bf16_sim": jnp.float32, "int8_sim": jnp.float32,
+    }[kind]
+
+
+def init_pool(num_pages: int, page_size: int, kv_heads: int, head_dim: int,
+              kind: str = "f32") -> PagedKVCache:
+    if kind not in KINDS:
+        raise ValueError(f"unknown cache kind {kind!r}; one of {KINDS}")
+    if num_pages < 2:
+        raise ValueError("num_pages must be >= 2 (page 0 is the garbage sink)")
+    shape = (num_pages, page_size, kv_heads, head_dim)
+    sshape = (num_pages, page_size, kv_heads) if kind == "int8" else (0,)
+    return PagedKVCache(
+        k=jnp.zeros(shape, _store_dtype(kind)),
+        v=jnp.zeros(shape, _store_dtype(kind)),
+        k_scale=jnp.zeros(sshape, jnp.float32),
+        v_scale=jnp.zeros(sshape, jnp.float32),
+        kind=kind,
+    )
+
+
+def _addr(table: jax.Array, positions: jax.Array, page_size: int):
+    """(pool page ids, in-page offsets) for flat ``positions`` [B, Q]
+    through ``table`` [B, max_pages]. Out-of-table positions (inactive
+    slots at stale depths) clamp to the last table column — which, for
+    an inactive slot's all-zero row, is the garbage page."""
+    max_pages = table.shape[1]
+    page_idx = jnp.clip(positions // page_size, 0, max_pages - 1)
+    pages = jnp.take_along_axis(table, page_idx, axis=1)
+    offs = positions % page_size
+    return pages, offs
+
+
+def write_tokens(pool: PagedKVCache, k_new: jax.Array, v_new: jax.Array,
+                 table: jax.Array, pos: jax.Array) -> PagedKVCache:
+    """Scatter ``k_new``/``v_new`` [B, Q, Hkv, Dh] — Q consecutive
+    tokens per slot starting at per-slot positions ``pos`` [B] — into
+    the pages the table maps for those positions. Active slots' target
+    pages are exclusively owned by construction (shared pages end before
+    the first decode-write position), so the scatter never races a
+    reader."""
+    ks, kscale = _encode(k_new, pool.kind)
+    vs, vscale = _encode(v_new, pool.kind)
+    q = k_new.shape[1]
+    positions = pos[:, None] + jnp.arange(q)[None, :]  # [B, Q]
+    pages, offs = _addr(table, positions, pool.page_size)
+    k = pool.k.at[pages, offs].set(ks)
+    v = pool.v.at[pages, offs].set(vs)
+    k_sc, v_sc = pool.k_scale, pool.v_scale
+    if pool.kind == "int8":
+        k_sc = k_sc.at[pages, offs].set(kscale)
+        v_sc = v_sc.at[pages, offs].set(vscale)
+    return PagedKVCache(k=k, v=v, k_scale=k_sc, v_scale=v_sc, kind=pool.kind)
+
+
+def write_chunk(pool: PagedKVCache, k_new: jax.Array, v_new: jax.Array,
+                table_row: jax.Array, start: int) -> PagedKVCache:
+    """Prefill write: ``k_new``/``v_new`` [1, C, Hkv, Dh] at flat
+    positions [start, start+C) of the one slot owning ``table_row``
+    [max_pages]. ``start`` is static (one compiled prefill program per
+    chunk index, shared across slots/requests — the in-page offsets are
+    compile-time constants, only the page ids are traced)."""
+    ks, kscale = _encode(k_new, pool.kind)
+    vs, vscale = _encode(v_new, pool.kind)
+    c = k_new.shape[1]
+    p = pool.page_size
+    flat = start + np.arange(c)
+    pages = table_row[np.clip(flat // p, 0, table_row.shape[0] - 1)]  # [C]
+    offs = jnp.asarray(flat % p, jnp.int32)
+    k = pool.k.at[pages, offs].set(ks[0])
+    v = pool.v.at[pages, offs].set(vs[0])
+    k_sc, v_sc = pool.k_scale, pool.v_scale
+    if pool.kind == "int8":
+        k_sc = k_sc.at[pages, offs].set(kscale[0])
+        v_sc = v_sc.at[pages, offs].set(vscale[0])
+    return PagedKVCache(k=k, v=v, k_scale=k_sc, v_scale=v_sc, kind=pool.kind)
+
+
+def read_table(pool: PagedKVCache, table: jax.Array,
+               dtype) -> tuple[jax.Array, jax.Array]:
+    """The J117-silent read: gather each slot's table rows from the pool
+    and flatten to a [B, max_pages·page_size, Hkv, Dh] key window whose
+    flat index IS the token position (row r, offset o → position
+    r·page_size + o). Unallocated table tail entries point at page 0 but
+    sit at flat positions beyond the slot's length, which the decode
+    mask (``k_pos <= pos``) excludes."""
+    b, m = table.shape
+    p, h, d = pool.k.shape[1:]
+    k = pool.k[table]  # [B, M, P, Hkv, Dh]
+    v = pool.v[table]
+    if pool.kind == "int8":
+        k = _dequant(k, pool.k_scale[table])
+        v = _dequant(v, pool.v_scale[table])
+    return (k.reshape(b, m * p, h, d).astype(dtype),
+            v.reshape(b, m * p, h, d).astype(dtype))
+
+
+def read_row_prefix(pool: PagedKVCache, table_row: jax.Array, length: int,
+                    dtype) -> tuple[jax.Array, jax.Array]:
+    """One slot's first ``length`` flat positions (static) for a prefill
+    chunk's attention window: [1, length, Hkv, Dh]."""
+    p, h, d = pool.k.shape[1:]
+    m = table_row.shape[0]
+    k = pool.k[table_row].reshape(m * p, h, d)
+    v = pool.v[table_row].reshape(m * p, h, d)
+    if pool.kind == "int8":
+        ks = pool.k_scale[table_row].reshape(m * p, h)
+        vs = pool.v_scale[table_row].reshape(m * p, h)
+        k = _dequant(k, ks)
+        v = _dequant(v, vs)
+    return k[None, :length].astype(dtype), v[None, :length].astype(dtype)
+
+
+def pool_bytes(pool: PagedKVCache) -> int:
+    """Total pool storage bytes (K + V + scales) — the equal-HBM axis of
+    the paged-vs-dense bench comparison."""
+    return sum(x.size * x.dtype.itemsize
+               for x in (pool.k, pool.v, pool.k_scale, pool.v_scale))
+
+
+class PagePool:
+    """Host-side page allocator + prefix index. Purely between-steps
+    bookkeeping: nothing here is traced, and every structure iterates in
+    a deterministic order (min-heap free list, insertion-ordered LRU),
+    so the scheduler's event log stays a pure function of (workload
+    seed, config).
+
+    Page lifecycle: free → allocated (refcount ≥ 1) → on last release,
+    either back to free (unregistered pages) or RETAINED (pages carrying
+    a prefix key — still matchable by future admits, evicted oldest-
+    first only when the free heap runs dry). Page 0 never enters the
+    allocator."""
+
+    def __init__(self, num_pages: int, page_size: int,
+                 prefix_sharing: bool = False):
+        if num_pages < 2:
+            raise ValueError("num_pages must be >= 2")
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.prefix_sharing = prefix_sharing
+        self._free: list[int] = list(range(1, num_pages))
+        heapq.heapify(self._free)
+        self.refcount = [0] * num_pages
+        self._retained: OrderedDict[int, None] = OrderedDict()
+        self._key_to_page: dict[bytes, int] = {}
+        self._page_key: dict[int, bytes] = {}
+        # Observability counters (bench/report): prefix hits = admits
+        # that reused >= 1 page; reused pages = prefill work avoided.
+        self.prefix_hits = 0
+        self.pages_reused = 0
+        self.retained_evictions = 0
+
+    @property
+    def available(self) -> int:
+        """Pages an alloc could obtain right now (free + evictable)."""
+        return len(self._free) + len(self._retained)
+
+    @property
+    def allocated(self) -> int:
+        return (self.num_pages - 1) - self.available
+
+    # ------------------------------------------------------------ sharing
+
+    def _key(self, prompt: np.ndarray, j: int) -> bytes:
+        return prompt[: (j + 1) * self.page_size].tobytes()
+
+    def match_prefix(self, prompt: np.ndarray) -> list[int]:
+        """Longest run of resident shared pages covering the prompt head.
+        Page j is matchable only if it ends strictly before the first
+        decode-write position ``len(prompt) - 1`` — so a matched page is
+        never written by the new request either."""
+        if not self.prefix_sharing:
+            return []
+        p = int(prompt.size) - 1  # prefilled positions are [0, p)
+        pages: list[int] = []
+        j = 0
+        while (j + 1) * self.page_size <= p:
+            pid = self._key_to_page.get(self._key(prompt, j))
+            if pid is None:
+                break
+            pages.append(pid)
+            j += 1
+        if pages:
+            self.prefix_hits += 1
+            self.pages_reused += len(pages)
+        return pages
+
+    def register(self, pid: int, prompt: np.ndarray, j: int) -> None:
+        """Publish page ``pid`` as holding prompt head page ``j``. First
+        resident writer wins — a key already mapping to a live page is
+        left alone (the new admit would have matched it instead)."""
+        key = self._key(prompt, j)
+        if self._key_to_page.get(key, pid) != pid:
+            return
+        self._key_to_page[key] = pid
+        self._page_key[pid] = key
+
+    def _unregister(self, pid: int) -> None:
+        key = self._page_key.pop(pid, None)
+        if key is not None and self._key_to_page.get(key) == pid:
+            del self._key_to_page[key]
+
+    # ---------------------------------------------------------- lifecycle
+
+    def acquire(self, pid: int) -> None:
+        """Take a reference on an already-resident (shared) page."""
+        if self.refcount[pid] == 0:
+            self._retained.pop(pid, None)
+        self.refcount[pid] += 1
+
+    def alloc_n(self, n: int) -> list[int] | None:
+        """n fresh pages, all-or-nothing (None leaves the pool exactly as
+        it was — the admit stays queued). Fresh pages come from the free
+        heap lowest-id-first, then from retained prefix pages oldest-
+        release-first (their keys are unregistered on eviction)."""
+        got: list[int] = []
+        for _ in range(n):
+            if self._free:
+                pid = heapq.heappop(self._free)
+            elif self._retained:
+                pid, _ = self._retained.popitem(last=False)
+                self._unregister(pid)
+                self.retained_evictions += 1
+            else:
+                for g in got:
+                    self.release(g)
+                return None
+            self.refcount[pid] = 1
+            got.append(pid)
+        return got
+
+    def release(self, pid: int) -> None:
+        rc = self.refcount[pid] - 1
+        if rc < 0:
+            raise RuntimeError(f"page {pid} released more times than acquired")
+        self.refcount[pid] = rc
+        if rc == 0:
+            if pid in self._page_key:
+                self._retained[pid] = None  # newest retention at LRU tail
+            else:
+                heapq.heappush(self._free, pid)

@@ -1,0 +1,139 @@
+"""Speculative decoding with exact greedy acceptance-rejection.
+
+A small DRAFT model proposes K tokens autoregressively, then the target
+model scores the whole K+1-token window in ONE pass
+(``apply_decode_window``): K+1 positions of target logits for one
+target-model step instead of K+1. Greedy acceptance keeps the longest
+prefix where the draft's argmax equals the target's argmax and emits the
+TARGET's token at the first mismatch (or the bonus K+1-th token when all
+match) — so the committed token stream is, by construction, EXACTLY what
+pure target-greedy would have produced, and the PR 8 parity tests pin
+spec mode with the same golden sequences. The throughput lever is
+``accepted_len``: every accepted draft token is a target decode step the
+engine did not run.
+
+Static shapes throughout: the window is always K+1 tokens for every slot
+every step (fixed-K discipline, per the pjit paper's static-shape rule),
+and the step returns ``(emitted [B, K+1], n_emit [B], ...)`` — the host
+commits the first ``n_emit`` per slot. Rejected draft rows leave stale
+K/V at positions >= the commit point in BOTH caches; the next window
+starts at the commit point and rewrites every such row before the mask
+first exposes it — the same stale-row invariant the dense engine's
+eviction path relies on (engine.py module docstring).
+
+The draft is by default a layer-truncated view of the target
+(``draft_from_trunk``): block0..n-1 + the shared embedding/head — zero
+extra training, decent agreement on repetitive traffic, and exactness
+never depends on draft quality.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+# Spec steps are jitted under their own NAME (not SERVE_DECODE_MARKER):
+# the verify window's [B, H, K+1, L] softmax would false-fire J110's
+# wide-softmax check on a single-token-marked program. J117 keys on this
+# marker too (the paged spec step gathers through the table like the
+# plain paged step). Mirrored in tpudml/analysis/jaxpr_pass.py.
+SPEC_DECODE_MARKER = "_serve_spec_decode_step"
+
+
+def draft_from_trunk(model, params, num_layers: int):
+    """(draft_model, draft_params): the target's first ``num_layers``
+    blocks with the shared embedding/ln_f/head. The cheapest possible
+    draft — no second set of weights to store or train — and any
+    agreement it achieves is pure speedup (exactness is the verify
+    step's job, not the draft's)."""
+    if not 1 <= num_layers < model.num_layers:
+        raise ValueError(
+            f"draft num_layers must be in [1, {model.num_layers}), "
+            f"got {num_layers}"
+        )
+    draft = dataclasses.replace(model, num_layers=num_layers)
+    keep = {"tok_embed", "ln_f", "head"}
+    keep |= {f"block{i}" for i in range(num_layers)}
+    if not model.rope:
+        keep.add("pos_embed")
+    dparams = {k: v for k, v in params.items() if k in keep}
+    return draft, dparams
+
+
+def _verify(window, logits, spec_k):
+    """Greedy acceptance over the scored window.
+
+    ``window`` [B, K+1] is [t0, d1..dK]; ``logits`` [B, K+1, V] row j
+    predicts position pos+j+1. Returns (emitted [B, K+1], n_emit [B]):
+    ``emitted`` is the target's greedy token at every window row — its
+    first ``accepted`` entries coincide with the draft's by definition
+    of acceptance, entry ``accepted`` is the target's correction at the
+    first mismatch (or the bonus token when all K drafts match)."""
+    emitted = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, K+1]
+    match = (window[:, 1:] == emitted[:, :spec_k]).astype(jnp.int32)
+    accepted = jnp.sum(jnp.cumprod(match, axis=1), axis=1)  # [B]
+    return emitted, accepted + 1
+
+
+def make_spec_decode_step(model, draft_model, spec_k: int, *,
+                          paged: bool = False):
+    """The one jitted spec-decode program. Dense signature
+    ``(params, dparams, caches, dcaches, tokens [B], pos [B])``; paged
+    inserts ``table`` [B, max_pages] after ``dcaches`` (the DRAFT cache
+    stays dense — it is small by construction, and one paged layout per
+    step keeps the program simple). Returns
+    ``(emitted [B, K+1], n_emit [B], logits [B, K+1, V], caches,
+    dcaches)``. Both caches are donated."""
+    if spec_k < 1:
+        raise ValueError("spec_k must be >= 1")
+
+    def _draft_window(dparams, dcaches, tokens, pos):
+        """K draft decode steps (unrolled — K is small and static):
+        [t0, d1..dK] plus the draft cache advanced through every window
+        row's K/V. The final call is write-only (its logits would
+        propose d_{K+1}, which no verify row scores): on a full accept
+        the commit point jumps to pos+K+1, so row pos+K — dK's K/V —
+        sits BELOW the next window's first write and would otherwise be
+        a permanent hole the draft attends through ever after. On any
+        rejection that row is merely stale and the next window rewrites
+        it before the mask exposes it."""
+        t = tokens
+        window = [tokens]
+        for j in range(spec_k):
+            d_logits, dcaches = draft_model.apply_decode(
+                dparams, dcaches, t, pos + j
+            )
+            t = jnp.argmax(d_logits, axis=-1).astype(jnp.int32)
+            window.append(t)
+        _, dcaches = draft_model.apply_decode(
+            dparams, dcaches, t, pos + spec_k
+        )
+        return jnp.stack(window, axis=1), dcaches  # [B, K+1]
+
+    if paged:
+        def _serve_spec_decode_step(params, dparams, caches, dcaches,
+                                    table, tokens, pos):
+            window, dcaches = _draft_window(dparams, dcaches, tokens, pos)
+            logits, caches = model.apply_decode_paged(
+                params, caches, table, window, pos
+            )
+            emitted, n_emit = _verify(window, logits, spec_k)
+            return emitted, n_emit, logits, caches, dcaches
+    else:
+        def _serve_spec_decode_step(params, dparams, caches, dcaches,
+                                    tokens, pos):
+            window, dcaches = _draft_window(dparams, dcaches, tokens, pos)
+            logits, caches = model.apply_decode_window(
+                params, caches, window, pos
+            )
+            emitted, n_emit = _verify(window, logits, spec_k)
+            return emitted, n_emit, logits, caches, dcaches
+
+    inner = jax.jit(_serve_spec_decode_step)
+
+    def step(*args):
+        return inner(*args)
+
+    return jax.jit(step, donate_argnums=(2, 3))

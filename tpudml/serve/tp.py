@@ -39,6 +39,19 @@ class TPServing:
     """Sharded decode + prefill programs for one (model, mesh, axis)."""
 
     def __init__(self, model, mesh, axis_name: str, cfg):
+        if getattr(cfg, "cache_layout", "dense") != "dense" or getattr(
+            cfg, "spec_k", 0
+        ):
+            # Defense in depth behind the engine's own guard: the manual
+            # shard_map decode body has no page-table or verify-window
+            # variant, and running the dense body against a paged/spec
+            # engine state would be a silent wrong-answer path.
+            from tpudml.serve.engine import ServeCompositionError
+
+            raise ServeCompositionError(
+                "TPServing supports cache_layout='dense' with spec_k=0 "
+                "only; paged/speculative serving is single-device"
+            )
         self.model = model
         self.mesh = mesh
         self.axis = axis_name
