@@ -188,7 +188,10 @@ def test_pagepool_prefix_retention_and_lru_eviction():
     for pid in (pages[2], pages[0], pages[1]):  # release order = LRU order
         pool.release(pid)
     assert pool.match_prefix(prompt) == [pages[0], pages[1]]
-    assert pool.prefix_hits == 1 and pool.pages_reused == 2
+    # Matching is side-effect-free — the reuse counters belong to the
+    # ADMIT (the engine bumps them once admission succeeds), so a
+    # page-starved retry can't inflate them.
+    assert pool.prefix_hits == 0 and pool.pages_reused == 0
     # Matching does NOT take a reference; acquire does.
     pool.acquire(pages[0])
     pool.acquire(pages[1])
@@ -198,6 +201,30 @@ def test_pagepool_prefix_retention_and_lru_eviction():
     assert pool.alloc_n(4) == [pages[2], 4, pages[0], pages[1]]
     assert pool.retained_evictions == 2
     assert pool.match_prefix(prompt) == []  # keys gone with the pages
+
+
+def test_pagepool_failed_alloc_restores_evicted_retained_pages():
+    """A failed (all-or-nothing) alloc_n that evicted retained prefix
+    pages mid-attempt must hand back their keys, retained status, and
+    LRU order — a deferred admit may not cost the prefix cache
+    anything."""
+    pool = PagePool(num_pages=4, page_size=2, prefix_sharing=True)
+    prompt = np.arange(6, dtype=np.int32)  # p=5: pages 0 and 1 shareable
+    pages = pool.alloc_n(3)  # [1, 2, 3]
+    pool.register(pages[0], prompt, 0)
+    pool.register(pages[1], prompt, 1)
+    for pid in pages:
+        pool.release(pid)
+    # free={3}, retained={1, 2}. Asking for 4 takes 3, evicts 1 then 2,
+    # then fails — and the rollback must undo the evictions too.
+    assert pool.alloc_n(4) is None
+    assert pool.available == 3
+    assert pool.retained_evictions == 0
+    assert pool.match_prefix(prompt) == [pages[0], pages[1]]
+    # Eviction order survives the rollback: free heap first, then the
+    # retained pages oldest-release-first, exactly as before the attempt.
+    assert pool.alloc_n(3) == [3, pages[0], pages[1]]
+    assert pool.retained_evictions == 2
 
 
 def test_pagepool_match_stops_before_decode_write_position():
@@ -291,6 +318,49 @@ def test_prefix_sharing_reuses_pages_without_changing_tokens():
         assert rep.requests[rid].shared_pages == 3
 
     dense_cfg = ServeConfig(slots=2, max_len=MAX_LEN, prefill_chunk=4)
+    ref = ServingEngine(model, params, dense_cfg).run(reqs)
+    for rid in ref.requests:
+        assert rep.requests[rid].tokens == ref.requests[rid].tokens
+
+
+def test_prefix_sharing_under_pool_pressure_never_aliases_pages():
+    """Admission must acquire matched shared pages BEFORE allocating the
+    fresh ones: a pressured alloc evicts retained pages oldest-first,
+    and without the acquire it can hand a just-matched page back as a
+    'fresh' page — the same pool page mapped at two table rows, so
+    decode writes silently corrupt the prompt K/V the request attends
+    over. Here the pool is sized so rid 2's admission finds exactly its
+    two matched pages in the retained LRU and only one free page: the
+    admit must DEFER (leaving the prefix cache intact) and succeed once
+    rid 1's pages free up, with every token still dense-exact."""
+    model = _model(rope=True, num_kv_heads=2)
+    params, _ = model.init(jax.random.key(4))
+    head = _prompt(9, seed=31)
+    reqs = [
+        # rid 0: 3 pages [1,2,3]; registers pages 0..1 of the head, done
+        # after 2 decode steps — pages 1,2 go RETAINED, page 3 frees.
+        Request(rid=0, prompt=head, max_new_tokens=2, arrival_time=0.0),
+        # rid 1: 2 pages [4,5], still running when rid 2 arrives.
+        Request(rid=1, prompt=_prompt(5, seed=32), max_new_tokens=3,
+                arrival_time=0.0),
+        # rid 2: shares the 8-token head (matches retained pages 1,2) and
+        # needs 2 fresh pages with only page 3 free — the pressure case.
+        Request(rid=2, prompt=np.concatenate([head[:8], _prompt(4, seed=33)]),
+                max_new_tokens=4, arrival_time=2.0),
+    ]
+    cfg = ServeConfig(slots=2, max_len=MAX_LEN, prefill_chunk=4,
+                      cache_layout="paged", page_size=4,
+                      prefix_sharing=True, num_pages=6, step_time_s=1.0)
+    rep = ServingEngine(model, params, cfg).run(reqs)
+    assert ("defer", 2, -1, 2) in rep.events  # page-starved, not aliased
+    assert rep.requests[2].shared_pages == 2
+    # A deferred-then-retried admit counts its prefix hit exactly once.
+    assert rep.pool_stats["prefix_hits"] == 1
+    assert rep.pool_stats["pages_reused"] == 2
+    assert rep.pool_stats["retained_evictions"] == 0
+
+    dense_cfg = ServeConfig(slots=2, max_len=MAX_LEN, prefill_chunk=4,
+                            step_time_s=1.0)
     ref = ServingEngine(model, params, dense_cfg).run(reqs)
     for rid in ref.requests:
         assert rep.requests[rid].tokens == ref.requests[rid].tokens

@@ -153,6 +153,26 @@ def test_perfect_draft_accepts_every_token():
     assert rep.requests[0].tokens == ref.requests[0].tokens
 
 
+def test_spec_event_logs_only_committed_tokens():
+    """A verify window truncated by the max_new_tokens budget logs the
+    accepted length actually COMMITTED, not the window's n_emit-1 — so
+    Σ (accepted_len + 1) over spec events is exactly the generated token
+    count and mean_accepted_len never overstates throughput."""
+    model = _model()
+    params, _ = model.init(jax.random.key(2))
+    # Perfect draft commits K+1=3 per step; max_new=4 truncates the
+    # second window after a single token (accepted_len 0, not 2).
+    reqs = [Request(rid=0, prompt=np.arange(5, dtype=np.int32),
+                    max_new_tokens=4)]
+    cfg = ServeConfig(slots=1, max_len=MAX_LEN, prefill_chunk=4, spec_k=2)
+    eng = ServingEngine(model, params, cfg, draft_model=model,
+                        draft_params=params)
+    rep = eng.run(reqs)
+    assert [e[4] for e in rep.events if e[0] == "spec"] == [2, 0]
+    assert sum(e[4] + 1 for e in rep.events
+               if e[0] == "spec") == rep.generated_tokens == 4
+
+
 def test_engine_requires_draft_params_with_draft_model():
     model = _model()
     params, _ = model.init(jax.random.key(0))

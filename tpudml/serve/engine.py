@@ -308,8 +308,10 @@ class ServeReport:
 
     @property
     def mean_accepted_len(self) -> float:
-        """Mean accepted draft tokens per spec step (0.0 without spec
-        events); tokens-per-target-step is ``1 + mean_accepted_len``."""
+        """Mean accepted draft tokens COMMITTED per spec step (0.0
+        without spec events; windows truncated by EOS/budget count only
+        what landed); tokens-per-target-step is ``1 + mean_accepted_len``
+        and Σ(accepted_len + 1) equals the generated token count."""
         ls = [e[4] for e in self.events if e[0] == "spec"]
         return float(np.mean(ls)) if ls else 0.0
 
@@ -571,11 +573,21 @@ class ServingEngine:
         pool = self._pool
         needed = math.ceil(total / cfg.page_size)
         shared = pool.match_prefix(prompt)  # only pages ending before p
-        fresh = pool.alloc_n(needed - len(shared))
-        if fresh is None:
-            return None
+        # Acquire the matched pages BEFORE allocating fresh ones: taking
+        # a reference pulls a retained page out of the eviction LRU, so
+        # a pressured alloc_n can never evict a page we are about to map
+        # as this slot's prefix (which would alias the same pool page at
+        # two table rows and let decode writes corrupt the prompt K/V).
         for pid in shared:
             pool.acquire(pid)
+        fresh = pool.alloc_n(needed - len(shared))
+        if fresh is None:
+            for pid in shared:
+                pool.release(pid)
+            return None
+        if shared:
+            pool.prefix_hits += 1
+            pool.pages_reused += len(shared)
         pages = shared + fresh
         row = np.zeros(cfg.max_pages, np.int32)
         row[: len(pages)] = pages
@@ -783,14 +795,13 @@ class ServingEngine:
                 if not active[i]:
                     continue
                 st = stats[slot_rid[i]]
-                if self._spec is not None:
-                    events.append(("spec", int(slot_rid[i]), i, steps,
-                                   int(n_emit_np[i]) - 1))
                 done = False
+                committed = 0
                 for tok in emitted_np[i, : int(n_emit_np[i])]:
                     tok = int(tok)
                     st.tokens.append(tok)
                     st.token_times.append(t_step)
+                    committed += 1
                     if st.first_token is None:
                         st.first_token = t_step
                     pos[i] += 1
@@ -801,6 +812,15 @@ class ServingEngine:
                     ):
                         done = True
                         break
+                if self._spec is not None:
+                    # accepted_len counts draft tokens actually COMMITTED
+                    # (committed - 1: the last commit is the target's
+                    # bonus/correction token) — a window truncated by EOS
+                    # or the max_new_tokens budget logs only what landed
+                    # in the ledger, so mean_accepted_len stays an exact
+                    # tokens-per-target-step accounting.
+                    events.append(("spec", int(slot_rid[i]), i, steps,
+                                   committed - 1))
                 if done:
                     st.finished = t_step
                     active[i] = False

@@ -257,7 +257,10 @@ class PagePool:
         """Longest run of resident shared pages covering the prompt head.
         Page j is matchable only if it ends strictly before the first
         decode-write position ``len(prompt) - 1`` — so a matched page is
-        never written by the new request either."""
+        never written by the new request either. Side-effect-free: the
+        prefix_hits/pages_reused counters are bumped by the caller once
+        admission actually succeeds, so a page-starved admit retried
+        every scheduler pass doesn't re-count the same hit."""
         if not self.prefix_sharing:
             return []
         p = int(prompt.size) - 1  # prefilled positions are [0, p)
@@ -269,9 +272,6 @@ class PagePool:
                 break
             pages.append(pid)
             j += 1
-        if pages:
-            self.prefix_hits += 1
-            self.pages_reused += len(pages)
         return pages
 
     def register(self, pid: int, prompt: np.ndarray, j: int) -> None:
@@ -301,18 +301,34 @@ class PagePool:
         """n fresh pages, all-or-nothing (None leaves the pool exactly as
         it was — the admit stays queued). Fresh pages come from the free
         heap lowest-id-first, then from retained prefix pages oldest-
-        release-first (their keys are unregistered on eviction)."""
+        release-first (their keys are unregistered on eviction). On
+        failure, retained pages evicted mid-attempt get their keys,
+        retained status, and LRU positions back — a deferred admit must
+        not cost the prefix cache anything."""
         got: list[int] = []
+        evicted: list[tuple[int, bytes]] = []  # (pid, key) in pop order
         for _ in range(n):
             if self._free:
                 pid = heapq.heappop(self._free)
             elif self._retained:
                 pid, _ = self._retained.popitem(last=False)
+                evicted.append((pid, self._page_key[pid]))
                 self._unregister(pid)
                 self.retained_evictions += 1
             else:
+                evicted_ids = {e for e, _ in evicted}
                 for g in got:
-                    self.release(g)
+                    self.refcount[g] = 0
+                    if g not in evicted_ids:
+                        heapq.heappush(self._free, g)
+                # Re-insert at the LRU head in reverse pop order so the
+                # original oldest-release-first order is restored.
+                for pid, key in reversed(evicted):
+                    self._page_key[pid] = key
+                    self._key_to_page[key] = pid
+                    self._retained[pid] = None
+                    self._retained.move_to_end(pid, last=False)
+                self.retained_evictions -= len(evicted)
                 return None
             self.refcount[pid] = 1
             got.append(pid)
