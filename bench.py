@@ -1030,6 +1030,75 @@ def main_sentinel() -> None:
     print(json.dumps(bench_sentinel(on_tpu)))
 
 
+def bench_obs(on_tpu) -> dict:
+    """``--obs`` report: the LeNet DP train step timed with the flight
+    recorder (``tpudml.obs``) off vs on — the observability tax. The on
+    position adds one host-side tracer span per dispatch AND the in-graph
+    StepStats pytree (grad norm, sentinel counters, comm-bytes constant)
+    to the jitted step, so the A/B prices the whole ``obs=True`` knob,
+    not just the tracer. Dispatched-step timing (not fori): the tracer
+    span wraps the dispatch, which fori would hide. Acceptance
+    (docs/OBSERVABILITY.md): ``overhead_frac`` < 0.02.
+    """
+    from tpudml.core.config import MeshConfig
+    from tpudml.core.dist import make_mesh
+    from tpudml.core.prng import seed_key
+    from tpudml.models import LeNet
+    from tpudml.optim import make_optimizer
+    from tpudml.parallel.dp import DataParallel
+
+    import numpy as np
+
+    devices = jax.devices()
+    mesh = make_mesh(MeshConfig({"data": len(devices)}), devices)
+    batch = (64 if on_tpu else 32) * len(devices)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(batch, 28, 28, 1)).astype("float32")
+    y = rng.integers(0, 10, size=(batch,)).astype("int32")
+    iters, reps = (40, 3) if on_tpu else (10, 3)
+
+    def timed(obs) -> float:
+        dp = DataParallel(
+            LeNet(), make_optimizer("sgd", 0.01, 0.9), mesh, obs=obs
+        )
+        ts = dp.create_state(seed_key(0))
+        step = dp.make_train_step()
+        for _ in range(3):  # compile + warm caches
+            ts, m = step(ts, x, y)
+        jax.block_until_ready(m["loss"])
+        runs = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                ts, m = step(ts, x, y)
+            jax.block_until_ready(m["loss"])
+            runs.append((time.perf_counter() - t0) / iters)
+        # Best-of-reps on both arms: the A/B divides two step times and
+        # the minimum is the least-noise estimator of each.
+        return min(runs)
+
+    sec_off = timed(False)
+    sec_on = timed(True)
+    return {
+        "metric": "obs_overhead_dp_step",
+        "config": {"model": "lenet", "batch": batch,
+                   "world": len(devices), "iters": iters, "reps": reps,
+                   "platform": "tpu" if on_tpu else "cpu_dryrun"},
+        "step_ms_off": round(sec_off * 1e3, 3),
+        "step_ms_on": round(sec_on * 1e3, 3),
+        "value": round(sec_on / sec_off - 1.0, 4),
+        "unit": "overhead_fraction",
+        "budget": 0.02,
+    }
+
+
+def main_obs() -> None:
+    """Driver for ``python bench.py --obs``: prints ONE JSON line, same
+    contract as ``main()``, for the flight-recorder on/off A/B."""
+    on_tpu = jax.devices()[0].platform != "cpu"
+    print(json.dumps(bench_obs(on_tpu)))
+
+
 def main_serve() -> None:
     """Driver for ``python bench.py --serve``: prints ONE JSON line, same
     contract as ``main()``, for the serving tier. ``--smoke`` runs only
@@ -1126,5 +1195,7 @@ if __name__ == "__main__":
         main_serve()
     elif "--sentinel" in sys.argv[1:]:
         main_sentinel()
+    elif "--obs" in sys.argv[1:]:
+        main_obs()
     else:
         main()

@@ -39,6 +39,7 @@ from tpudml.data import DataLoader, ShardedDataLoader
 from tpudml.data.sampler import make_sampler
 from tpudml.metrics import MetricsWriter
 from tpudml.models import LeNet
+from tpudml.obs.tracer import Tracer, set_tracer
 from tpudml.optim import make_optimizer
 from tpudml.parallel.dp import DataParallel
 from tpudml.train import evaluate, train_loop
@@ -79,6 +80,11 @@ def run(cfg: TrainConfig) -> dict:
 
     model = LeNet(in_channels=train_set.images.shape[-1])
     optimizer = make_optimizer(cfg.optimizer, cfg.lr, cfg.momentum)
+    # Flight recorder (--obs, docs/OBSERVABILITY.md): one Tracer feeds the
+    # engine's step spans, the split-step comm spans, and — via the
+    # ambient hook — checkpoint/sentinel/launcher events; exported as
+    # run_dir/trace.json at the end of the run.
+    tracer = Tracer() if cfg.obs else None
     dp = DataParallel(
         model,
         optimizer,
@@ -86,41 +92,56 @@ def run(cfg: TrainConfig) -> dict:
         aggregation=cfg.aggregation,
         zero1=cfg.zero1,
         sentinel=cfg.sentinel,
+        obs=tracer if tracer is not None else False,
         measure_comm=cfg.measure_comm or cfg.bottleneck_rank is not None,
         bottleneck_rank=cfg.bottleneck_rank,
         bottleneck_delay_s=cfg.bottleneck_delay_s,
         accum_steps=cfg.accum_steps,
         stacked_batches=True,  # ShardedDataLoader yields [world, B, ...]
     )
-    ts = dp.create_state(seed_key(cfg.seed))
-    ts, hooks, ckpt_mgr = setup_checkpointing(cfg, ts)
-    if dp.sentinel is not None:
-        # Escalate past the consecutive-skip budget with a diagnostic
-        # naming the poisoned leaf/microbatch (docs/RESILIENCE.md).
-        from tpudml.resilience import sentinel_hook
+    # Ambient tracer install (restored on exit): checkpoint save/verify
+    # and sentinel-trip events emitted by the cross-cutting layers land on
+    # the same timeline as the engine's spans.
+    prev_tracer = set_tracer(tracer) if tracer is not None else None
+    try:
+        ts = dp.create_state(seed_key(cfg.seed))
+        ts, hooks, ckpt_mgr = setup_checkpointing(cfg, ts)
+        if dp.sentinel is not None:
+            # Escalate past the consecutive-skip budget with a diagnostic
+            # naming the poisoned leaf/microbatch (docs/RESILIENCE.md).
+            from tpudml.resilience import sentinel_hook
 
-        hooks.append(sentinel_hook(dp.sentinel, ts.params))
-    step = dp.make_train_step()
+            hooks.append(sentinel_hook(dp.sentinel, ts.params))
+        step = dp.make_train_step()
 
-    writer = MetricsWriter(cfg.log_dir, run_name=f"task2-{cfg.aggregation}-w{world}")
-    with trace(writer.run_dir / "profile", enabled=cfg.profile):
-        ts, metrics = train_loop(
-            model,
-            optimizer,
-            train_loader,
-            cfg.epochs,
-            seed_key(cfg.seed),
-            writer=writer,
-            log_every=cfg.log_every,
-            step_fn=step,
-            state=ts,
-            hooks=hooks,
+        writer = MetricsWriter(
+            cfg.log_dir, run_name=f"task2-{cfg.aggregation}-w{world}"
         )
-    final_checkpoint(ckpt_mgr, ts)
+        with trace(writer.run_dir / "profile", enabled=cfg.profile):
+            ts, metrics = train_loop(
+                model,
+                optimizer,
+                train_loader,
+                cfg.epochs,
+                seed_key(cfg.seed),
+                writer=writer,
+                log_every=cfg.log_every,
+                step_fn=step,
+                state=ts,
+                hooks=hooks,
+            )
+        final_checkpoint(ckpt_mgr, ts)
+    finally:
+        if tracer is not None:
+            set_tracer(prev_tracer)
     if dp.comm_stats.calls:
         print(dp.comm_stats.report())  # reference print parity: model-mp.py:79
         writer.add_scalar("Comm Time", dp.comm_stats.comm_time_s, int(ts.step))
         metrics["comm_time_s"] = dp.comm_stats.comm_time_s
+    if tracer is not None:
+        trace_path = tracer.export(writer.run_dir / "trace.json")
+        print(f"[obs] trace: {trace_path}")
+        metrics["trace_path"] = str(trace_path)
 
     acc = evaluate(model, ts, test_loader)
     print(f"Test accuracy: {acc * 100:.2f}%")

@@ -82,6 +82,13 @@ def parse_args(argv=None) -> argparse.Namespace:
                    metavar=("MIN", "MAX"))
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--log_dir", type=str, default="./logs")
+    # observability
+    p.add_argument("--obs", action="store_true",
+                   help="write run_dir/trace.json from the event log "
+                        "(pure conversion, Perfetto-openable)")
+    p.add_argument("--step_time_s", type=float, default=None,
+                   help="virtual decode-step clock (deterministic runs + "
+                        "real-time trace timestamps)")
     return p.parse_args(argv)
 
 
@@ -107,6 +114,7 @@ def build_engine(args) -> ServingEngine:
         cache_layout="paged" if args.paged else "dense",
         page_size=args.page_size, num_pages=args.num_pages,
         prefix_sharing=args.prefix_sharing, spec_k=args.spec_k, slo=slo,
+        step_time_s=args.step_time_s,
     )
     if args.tp:
         from tpudml.core.config import MeshConfig
@@ -160,6 +168,15 @@ def run(args) -> dict:
     writer.add_scalar("Per-Token p99 (ms)", lat["per_token_p99_s"] * 1e3, 0)
     writer.add_scalar("E2E p99 (s)", lat["e2e_p99_s"], 0)
     writer.close()
+    trace_path = None
+    if args.obs:
+        from tpudml.obs import write_serve_trace
+
+        trace_path = write_serve_trace(
+            report, writer.run_dir / "trace.json",
+            step_time_s=args.step_time_s,
+        )
+        print(f"[obs] trace: {trace_path}")
 
     refills = sum(1 for e in report.events if e[0] == "admit" and e[3] > 0)
     mode = "".join([
@@ -195,6 +212,7 @@ def run(args) -> dict:
         "mid_flight_refills": refills,
         "mean_accepted_len": report.mean_accepted_len,
         "pool_stats": report.pool_stats,
+        "trace_path": str(trace_path) if trace_path else None,
         **lat,
     }
 

@@ -1,0 +1,130 @@
+"""Human-readable summary of one run directory's observability artifacts.
+
+Reads whatever the flight recorder left behind (docs/OBSERVABILITY.md) —
+any subset is fine; missing files just skip their section:
+
+- ``metrics.jsonl``  — the MetricsWriter scalar stream (loss, ``obs/*``
+  StepStats tags, comm/serve scalars);
+- ``trace.json``     — the Chrome-trace-event export (per-category span
+  count / total / p50 / p99);
+- ``obs/drift.json`` — the static-vs-measured drift report
+  (``python -m tpudml.obs --check-drift --out ...``).
+
+Usage::
+
+    python -m tools.obs_report RUN_DIR
+    python -m tools.obs_report logs/2026-08-05/12-00-00-task2-allreduce-w2
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def _fmt_row(cols: list, widths: list[int]) -> str:
+    return "  ".join(str(c).ljust(w) for c, w in zip(cols, widths)).rstrip()
+
+
+def _table(header: list, rows: list[list]) -> str:
+    widths = [
+        max(len(str(header[i])), *(len(str(r[i])) for r in rows))
+        for i in range(len(header))
+    ]
+    lines = [_fmt_row(header, widths), _fmt_row(["-" * w for w in widths], widths)]
+    lines += [_fmt_row(r, widths) for r in rows]
+    return "\n".join(lines)
+
+
+def metrics_summary(path: Path) -> str | None:
+    """Per-tag count / first / last from ``metrics.jsonl`` (every line is
+    strict JSON — the writer serializes non-finite values as null with
+    ``"finite": false``)."""
+    if not path.is_file():
+        return None
+    series: dict[str, list] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)  # strict by contract
+            series.setdefault(rec["tag"], []).append(rec["value"])
+    if not series:
+        return None
+    rows = []
+    for tag in sorted(series):
+        vals = series[tag]
+        fmt = lambda v: "non-finite" if v is None else f"{v:.6g}"
+        rows.append([tag, len(vals), fmt(vals[0]), fmt(vals[-1])])
+    return _table(["tag", "points", "first", "last"], rows)
+
+
+def trace_summary(path: Path) -> str | None:
+    """Per-(cat, name) span aggregates from an exported ``trace.json``,
+    via the same ``Tracer.summary()`` percentiles the live recorder uses."""
+    if not path.is_file():
+        return None
+    from tpudml.obs.tracer import Tracer
+
+    doc = json.loads(path.read_text())
+    tracer = Tracer()
+    tracer.add_events([
+        e for e in doc.get("traceEvents", []) if e.get("ph") in ("X", "i")
+    ])
+    spans = tracer.summary()["spans"]
+    if not spans:
+        return None
+    rows = [
+        [key, st["count"], st["total_us"], st["p50_us"], st["p99_us"]]
+        for key, st in spans.items()
+    ]
+    return _table(["span (cat/name)", "count", "total_us", "p50_us", "p99_us"], rows)
+
+
+def drift_summary(path: Path) -> str | None:
+    """The drift monitor's verdict table (``obs/drift.json``)."""
+    if not path.is_file():
+        return None
+    from tpudml.obs.drift import format_drift_table
+
+    return format_drift_table(json.loads(path.read_text()))
+
+
+def report(run_dir: str | Path) -> str:
+    run_dir = Path(run_dir)
+    sections = [
+        ("metrics.jsonl", metrics_summary(run_dir / "metrics.jsonl")),
+        ("trace.json", trace_summary(run_dir / "trace.json")),
+        ("obs/drift.json", drift_summary(run_dir / "obs" / "drift.json")),
+    ]
+    out = [f"== obs report: {run_dir} =="]
+    found = False
+    for title, body in sections:
+        if body is None:
+            continue
+        found = True
+        out.append(f"\n-- {title} --\n{body}")
+    if not found:
+        out.append("(no observability artifacts found)")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("run_dir", help="run directory (MetricsWriter.run_dir)")
+    args = p.parse_args(argv)
+    if not Path(args.run_dir).is_dir():
+        print(f"error: {args.run_dir} is not a directory", file=sys.stderr)
+        return 2
+    print(report(args.run_dir))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

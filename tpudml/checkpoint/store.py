@@ -100,43 +100,51 @@ def save_checkpoint(
     """
     directory = os.fspath(directory)
     path = os.path.join(directory, f"step_{step}")
-    try:
-        # Every process materialises the leaves: GSPMD-sharded arrays can
-        # span devices process 0 cannot address, so cross-host shards are
-        # allgathered (a collective — all processes must participate).
-        leaves = [_fetch_leaf(x) for x in jax.tree.leaves(tree)]
-        if process_index() == 0:
-            arrays, descs, checksums = {}, {}, {}
-            for i, leaf in enumerate(leaves):
-                arr, desc = _encode_leaf(np.asarray(leaf))
-                arrays[f"leaf_{i:05d}"] = arr
-                checksums[f"leaf_{i:05d}"] = _crc(arr)
-                if desc is not None:
-                    descs[str(i)] = desc
-            os.makedirs(directory, exist_ok=True)
-            tmp = tempfile.mkdtemp(prefix=".tmp_ckpt_", dir=directory)
-            try:
-                np.savez(os.path.join(tmp, _LEAVES), **arrays)
-                manifest = {
-                    "format": 2,
-                    "step": int(step),
-                    "num_leaves": len(leaves),
-                    "extended_dtypes": descs,
-                    "checksums": checksums,
-                    "metadata": metadata or {},
-                }
-                with open(os.path.join(tmp, _MANIFEST), "w") as f:
-                    json.dump(manifest, f)
-                if os.path.isdir(path):
-                    shutil.rmtree(path)
-                os.replace(tmp, path)
-            except BaseException:
-                shutil.rmtree(tmp, ignore_errors=True)
-                raise
-    finally:
-        # Reached on all paths: a process-0 write failure must not leave
-        # the other hosts blocked in the barrier forever.
-        _barrier(f"save.{step}")
+    from tpudml.obs.tracer import get_tracer
+
+    # Ambient flight-recorder span (tpudml.obs): a disabled tracer makes
+    # this a shared no-op context manager — zero allocation.
+    with get_tracer().span(
+        "checkpoint_save", cat="checkpoint", args={"step": int(step)}
+    ):
+        try:
+            # Every process materialises the leaves: GSPMD-sharded arrays
+            # can span devices process 0 cannot address, so cross-host
+            # shards are allgathered (a collective — all processes must
+            # participate).
+            leaves = [_fetch_leaf(x) for x in jax.tree.leaves(tree)]
+            if process_index() == 0:
+                arrays, descs, checksums = {}, {}, {}
+                for i, leaf in enumerate(leaves):
+                    arr, desc = _encode_leaf(np.asarray(leaf))
+                    arrays[f"leaf_{i:05d}"] = arr
+                    checksums[f"leaf_{i:05d}"] = _crc(arr)
+                    if desc is not None:
+                        descs[str(i)] = desc
+                os.makedirs(directory, exist_ok=True)
+                tmp = tempfile.mkdtemp(prefix=".tmp_ckpt_", dir=directory)
+                try:
+                    np.savez(os.path.join(tmp, _LEAVES), **arrays)
+                    manifest = {
+                        "format": 2,
+                        "step": int(step),
+                        "num_leaves": len(leaves),
+                        "extended_dtypes": descs,
+                        "checksums": checksums,
+                        "metadata": metadata or {},
+                    }
+                    with open(os.path.join(tmp, _MANIFEST), "w") as f:
+                        json.dump(manifest, f)
+                    if os.path.isdir(path):
+                        shutil.rmtree(path)
+                    os.replace(tmp, path)
+                except BaseException:
+                    shutil.rmtree(tmp, ignore_errors=True)
+                    raise
+        finally:
+            # Reached on all paths: a process-0 write failure must not
+            # leave the other hosts blocked in the barrier forever.
+            _barrier(f"save.{step}")
     return path
 
 
@@ -182,39 +190,48 @@ def restore_checkpoint(
     unreadable file; ``verify=False`` trusts the bytes.
     """
     path = os.fspath(path)
-    manifest = _read_manifest(path)
-    target_leaves, treedef = jax.tree.flatten(target)
-    if manifest["num_leaves"] != len(target_leaves):
-        raise ValueError(
-            f"checkpoint has {manifest['num_leaves']} leaves, target has "
-            f"{len(target_leaves)} — structure mismatch"
-        )
-    descs = manifest["extended_dtypes"]
-    checksums = manifest.get("checksums", {})
-    leaves = []
-    try:
-        with np.load(os.path.join(path, _LEAVES)) as data:
-            for i in range(len(target_leaves)):
-                key = f"leaf_{i:05d}"
-                raw = data[key]
-                if verify and key in checksums and _crc(raw) != checksums[key]:
-                    raise CheckpointCorruptError(
-                        f"{path}: leaf {i} checksum mismatch (corrupt data)"
-                    )
-                leaves.append(_decode_leaf(raw, descs.get(str(i))))
-    except CheckpointCorruptError:
-        raise
-    except Exception as e:  # truncated zip, missing member, zlib error …
-        raise CheckpointCorruptError(
-            f"{path}: unreadable {_LEAVES}: {e!r}"
-        ) from e
-    for i, (new, old) in enumerate(zip(leaves, target_leaves)):
-        if hasattr(old, "shape") and tuple(new.shape) != tuple(np.shape(old)):
+    from tpudml.obs.tracer import get_tracer
+
+    with get_tracer().span(
+        "checkpoint_restore", cat="checkpoint",
+        args={"path": os.path.basename(path), "verify": bool(verify)},
+    ):
+        manifest = _read_manifest(path)
+        target_leaves, treedef = jax.tree.flatten(target)
+        if manifest["num_leaves"] != len(target_leaves):
             raise ValueError(
-                f"leaf {i}: checkpoint shape {tuple(new.shape)} != target "
-                f"shape {tuple(np.shape(old))}"
+                f"checkpoint has {manifest['num_leaves']} leaves, target has "
+                f"{len(target_leaves)} — structure mismatch"
             )
-    return jax.tree.unflatten(treedef, leaves)
+        descs = manifest["extended_dtypes"]
+        checksums = manifest.get("checksums", {})
+        leaves = []
+        try:
+            with np.load(os.path.join(path, _LEAVES)) as data:
+                for i in range(len(target_leaves)):
+                    key = f"leaf_{i:05d}"
+                    raw = data[key]
+                    if (
+                        verify and key in checksums
+                        and _crc(raw) != checksums[key]
+                    ):
+                        raise CheckpointCorruptError(
+                            f"{path}: leaf {i} checksum mismatch (corrupt data)"
+                        )
+                    leaves.append(_decode_leaf(raw, descs.get(str(i))))
+        except CheckpointCorruptError:
+            raise
+        except Exception as e:  # truncated zip, missing member, zlib error …
+            raise CheckpointCorruptError(
+                f"{path}: unreadable {_LEAVES}: {e!r}"
+            ) from e
+        for i, (new, old) in enumerate(zip(leaves, target_leaves)):
+            if hasattr(old, "shape") and tuple(new.shape) != tuple(np.shape(old)):
+                raise ValueError(
+                    f"leaf {i}: checkpoint shape {tuple(new.shape)} != target "
+                    f"shape {tuple(np.shape(old))}"
+                )
+        return jax.tree.unflatten(treedef, leaves)
 
 
 def verify_checkpoint(path: str | os.PathLike) -> int:
@@ -226,24 +243,30 @@ def verify_checkpoint(path: str | os.PathLike) -> int:
     (no ``checksums``) pass if every leaf is structurally readable.
     """
     path = os.fspath(path)
-    manifest = _read_manifest(path)
-    checksums = manifest.get("checksums", {})
-    try:
-        with np.load(os.path.join(path, _LEAVES)) as data:
-            for i in range(int(manifest["num_leaves"])):
-                key = f"leaf_{i:05d}"
-                raw = data[key]
-                if key in checksums and _crc(raw) != checksums[key]:
-                    raise CheckpointCorruptError(
-                        f"{path}: leaf {i} checksum mismatch (corrupt data)"
-                    )
-    except CheckpointCorruptError:
-        raise
-    except Exception as e:
-        raise CheckpointCorruptError(
-            f"{path}: unreadable {_LEAVES}: {e!r}"
-        ) from e
-    return int(manifest["step"])
+    from tpudml.obs.tracer import get_tracer
+
+    with get_tracer().span(
+        "checkpoint_verify", cat="checkpoint",
+        args={"path": os.path.basename(path)},
+    ):
+        manifest = _read_manifest(path)
+        checksums = manifest.get("checksums", {})
+        try:
+            with np.load(os.path.join(path, _LEAVES)) as data:
+                for i in range(int(manifest["num_leaves"])):
+                    key = f"leaf_{i:05d}"
+                    raw = data[key]
+                    if key in checksums and _crc(raw) != checksums[key]:
+                        raise CheckpointCorruptError(
+                            f"{path}: leaf {i} checksum mismatch (corrupt data)"
+                        )
+        except CheckpointCorruptError:
+            raise
+        except Exception as e:
+            raise CheckpointCorruptError(
+                f"{path}: unreadable {_LEAVES}: {e!r}"
+            ) from e
+        return int(manifest["step"])
 
 
 def _all_step_dirs(directory: str) -> list[tuple[int, str]]:

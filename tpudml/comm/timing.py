@@ -73,12 +73,25 @@ class CommStats:
     calls: int = 0
     per_call_s: list = field(default_factory=list)
     comm_bytes: float = 0.0
+    # Flight-recorder feed (tpudml.obs): with a Tracer attached, every
+    # timed call additionally lands on the trace timeline as a complete
+    # span in the "comm" category — the engines' obs= knob sets this.
+    tracer: Any = None
+    label: str = "comm"
 
     def add(self, dt: float, nbytes: float = 0.0) -> None:
         self.comm_time_s += dt
         self.calls += 1
         self.per_call_s.append(dt)
         self.comm_bytes += nbytes
+        if self.tracer is not None and self.tracer.enabled:
+            dur_us = int(dt * 1e6)
+            args = {"bytes": nbytes} if nbytes else None
+            self.tracer.add_complete(
+                self.label, cat="comm",
+                ts_us=max(self.tracer.now_us() - dur_us, 0),
+                dur_us=dur_us, args=args,
+            )
 
     def percentiles(self) -> dict:
         """p50/p99 of the recorded per-call spans (empty dict when no

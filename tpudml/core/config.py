@@ -119,6 +119,7 @@ class TrainConfig:
     measure_comm: bool = False  # split-step comm-time accounting mode
     zero1: bool = False  # ZeRO-1 weight-update sharding on the DP engine
     sentinel: bool = False  # in-graph step sentinel (skip non-finite updates)
+    obs: bool = False  # flight recorder: trace.json + in-graph StepStats
     accum_steps: int = 1  # gradient-accumulation micro-batches per step
     log_dir: str = "./logs"
     profile: bool = False  # capture a jax.profiler trace into the run dir

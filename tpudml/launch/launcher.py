@@ -119,6 +119,14 @@ def launch(
             f"[launch] {why}; restart {attempt}/{spec.max_restarts}{tail}\n"
         )
         out.flush()
+        from tpudml.obs.tracer import get_tracer
+
+        # Ambient flight recorder (tpudml.obs): restarts land on the
+        # supervisor's trace as instants (no-op when no tracer installed).
+        get_tracer().instant(
+            "launch_restart", cat="launch",
+            args={"attempt": attempt, "why": why, "backoff_s": delay},
+        )
         if delay > 0:
             time.sleep(delay)
             total_elapsed += delay

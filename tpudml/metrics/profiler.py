@@ -49,6 +49,16 @@ class SpanTimer:
     launched it — the semantic of the reference's cuda-Event timing
     (sections/task2.tex:72-80).
 
+    Each span's per-call durations feed a :class:`CommStats`, so
+    ``report()`` carries p50/p99 alongside the mean (totals-only means
+    hide tail latency — the quantity serving/step-time work cares about)
+    on the same interpolation as every other percentile in the repo.
+
+    A :class:`tpudml.obs.Tracer` passed as ``tracer=`` additionally
+    receives every span as a structured trace event — SpanTimer is the
+    thin wall-clock façade; the tracer is the flight recorder that
+    subsumes it.
+
     Usage::
 
         timer = SpanTimer()
@@ -57,9 +67,13 @@ class SpanTimer:
         print(timer.report())
     """
 
-    def __init__(self):
+    def __init__(self, tracer=None):
+        from tpudml.comm.timing import CommStats
+
         self.totals: dict[str, float] = defaultdict(float)
         self.counts: dict[str, int] = defaultdict(int)
+        self.stats: dict[str, CommStats] = defaultdict(CommStats)
+        self.tracer = tracer
 
     @contextmanager
     def span(self, name: str, sync=None) -> Iterator[None]:
@@ -69,16 +83,37 @@ class SpanTimer:
         finally:
             if sync is not None:
                 jax.block_until_ready(sync)
-            self.totals[name] += time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            self.totals[name] += dt
             self.counts[name] += 1
+            self.stats[name].add(dt)
+            if self.tracer is not None and self.tracer.enabled:
+                dur_us = int(dt * 1e6)
+                self.tracer.add_complete(
+                    name, cat="timer",
+                    ts_us=max(self.tracer.now_us() - dur_us, 0),
+                    dur_us=dur_us,
+                )
 
     def mean(self, name: str) -> float:
         return self.totals[name] / max(self.counts[name], 1)
 
+    def percentiles(self, name: str) -> dict:
+        """p50/p99 seconds for one span (``{}`` before any call) —
+        delegated to ``CommStats.percentiles`` so SpanTimer and the comm
+        accounting interpolate identically."""
+        return self.stats[name].percentiles()
+
     def report(self) -> str:
-        parts = [
-            f"{name}: {self.totals[name]:.4f}s over {self.counts[name]} calls "
-            f"(mean {self.mean(name) * 1e3:.2f}ms)"
-            for name in sorted(self.totals)
-        ]
+        parts = []
+        for name in sorted(self.totals):
+            line = (
+                f"{name}: {self.totals[name]:.4f}s over {self.counts[name]} "
+                f"calls (mean {self.mean(name) * 1e3:.2f}ms"
+            )
+            pct = self.percentiles(name)
+            if pct:
+                line += (f", p50 {pct['p50_s'] * 1e3:.2f}ms,"
+                         f" p99 {pct['p99_s'] * 1e3:.2f}ms")
+            parts.append(line + ")")
         return "\n".join(parts)

@@ -21,6 +21,7 @@ just tags written through the same interface.
 from __future__ import annotations
 
 import json
+import math
 import shutil
 import time
 from datetime import datetime
@@ -64,17 +65,35 @@ class MetricsWriter:
 
     def add_scalar(self, tag: str, value, step: int) -> None:
         """Reference-compatible scalar API (``writer.add_scalar('Train Loss',
-        loss, counter)``, codes/task1/pytorch/model.py:57-58)."""
+        loss, counter)``, codes/task1/pytorch/model.py:57-58).
+
+        Non-finite values serialize as ``null`` with ``"finite": false``
+        — ``json.dumps(float("nan"))`` emits a bare ``NaN`` token, which
+        is not JSON and broke every strict parser reading
+        ``metrics.jsonl`` (a diverged run's loss would corrupt the whole
+        file for downstream tooling). Every line this writer emits
+        round-trips through ``json.loads``.
+        """
+        v = float(value)
         rec = {
             "tag": tag,
-            "value": float(value),
+            "value": v,
             "step": int(step),
             "wall_time": time.time(),
         }
+        if not math.isfinite(v):
+            rec["value"] = None
+            rec["finite"] = False
         if self._jsonl:
             self._jsonl.write(json.dumps(rec) + "\n")
         if self._tb:
-            self._tb.add_scalar(tag, rec["value"], step)
+            self._tb.add_scalar(tag, v, step)
+
+    def add_scalars(self, scalars: dict, step: int) -> None:
+        """Write a dict of scalars in one call (the obs StepStats
+        streaming path); insertion order is preserved in the jsonl."""
+        for tag, value in scalars.items():
+            self.add_scalar(tag, value, step)
 
     def close(self) -> None:
         if self._jsonl:

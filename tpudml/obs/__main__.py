@@ -1,0 +1,116 @@
+"""CLI: ``python -m tpudml.obs [--check-drift] [...]``.
+
+Runs the static-vs-measured drift monitor and writes ``obs/drift.json``.
+Report-only by default (always exit 0); ``--check-drift`` is the CI gate
+— non-zero exit when any entrypoint's relative error exceeds the
+threshold, mirroring the analysis CLI's ``--strict`` contract and its
+``--format text|json|github`` output modes. ``--fixture`` compares
+pre-recorded (static, measured) pairs from a JSON file instead of
+running the live world-4 regimes — the seeded-mismatch path the tests
+gate on, and the mode a TPU-less CI box can run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tpudml.obs.drift import (
+    DEFAULT_THRESHOLD,
+    DRIFT_REPORT_PATH,
+    REGIMES,
+    build_drift_report,
+    drift_from_pairs,
+    format_drift_table,
+    write_drift_report,
+)
+
+
+def _github_lines(report: dict, path: str) -> list[str]:
+    out = []
+    for r in report["records"]:
+        if r["status"] != "WARN":
+            continue
+        # '::' inside the message would terminate the annotation early.
+        msg = (f"static-vs-measured drift {r['rel_err'] * 100:.2f}% > "
+               f"{report['threshold'] * 100:.0f}% on {r['entrypoint']} "
+               f"(static {r['static_wire_bytes']:.0f} B, measured "
+               f"{r['measured_wire_bytes']:.0f} B)").replace("::", ":")
+        out.append(f"::warning file={path}::{msg}")
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tpudml.obs",
+        description="Drift monitor: measured CommStats wire bytes vs the "
+                    "static cost model, per analysis entrypoint "
+                    "(docs/OBSERVABILITY.md).",
+    )
+    parser.add_argument("--check-drift", action="store_true",
+                        help="gate mode: exit 1 when any entrypoint "
+                             "drifts past the threshold")
+    parser.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD,
+                        help="relative-error WARN threshold "
+                             f"(default {DEFAULT_THRESHOLD:.0%})")
+    parser.add_argument("--format", default="text", dest="fmt",
+                        choices=("text", "json", "github"),
+                        help="output format (default: text)")
+    parser.add_argument("--out", default=DRIFT_REPORT_PATH,
+                        help=f"drift report path (default {DRIFT_REPORT_PATH})")
+    parser.add_argument("--fixture", default=None, metavar="JSON",
+                        help="compare pre-recorded pairs from this file "
+                             "instead of running the live regimes "
+                             "(list of {entrypoint, static_wire_bytes, "
+                             "measured_wire_bytes} or {'records': [...]})")
+    parser.add_argument("--regimes", default=None, metavar="A,B",
+                        help="comma-separated live regimes "
+                             f"(default: all; known: {', '.join(REGIMES)})")
+    args = parser.parse_args(argv)
+
+    if args.threshold <= 0:
+        parser.error("--threshold must be > 0")
+
+    if args.fixture is not None:
+        with open(args.fixture) as f:
+            data = json.load(f)
+        pairs = data["records"] if isinstance(data, dict) else data
+        records = drift_from_pairs(pairs)
+    else:
+        names = None
+        if args.regimes:
+            names = [n.strip() for n in args.regimes.split(",") if n.strip()]
+            unknown = [n for n in names if n not in REGIMES]
+            if unknown:
+                parser.error(f"unknown regimes {unknown}; "
+                             f"known: {', '.join(REGIMES)}")
+        # The live regimes trace/measure on a world-4 mesh: provision the
+        # 8-device CPU host platform before the first backend touch (the
+        # same dance as python -m tpudml.analysis / tests/conftest.py).
+        from tpudml.analysis.__main__ import _provision_devices
+
+        _provision_devices()
+        from tpudml.obs.drift import drift_records
+
+        records = drift_records(names)
+
+    report = build_drift_report(records, threshold=args.threshold)
+    path = write_drift_report(report, args.out)
+
+    if args.fmt == "json":
+        print(json.dumps(report, indent=2, sort_keys=True))
+    elif args.fmt == "github":
+        for line in _github_lines(report, path):
+            print(line)
+    else:
+        print(format_drift_table(report))
+        print(f"wrote {path}")
+
+    if args.check_drift and not report["ok"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

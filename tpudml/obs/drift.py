@@ -1,0 +1,157 @@
+"""Static-vs-measured drift monitor.
+
+PR 10 pinned the static ``--cost`` byte counts within 5% of the measured
+``CommStats`` accounting — once, in a test. The ROADMAP's planner item
+needs that agreement tracked CONTINUOUSLY: a planner that prices
+candidate configs with a cost model that has silently drifted from the
+measured path ranks them wrong. This module re-derives the comparison as
+a runtime artifact: per analysis entrypoint, run the engine's
+measure_comm path for one step (measured wire bytes + comm time), trace
+the fused program through the dataflow interpreter (static wire bytes on
+the SAME ring model), and record the relative error. ``obs/drift.json``
+carries the records; anything past the threshold (default 10%) is a WARN
+and — under ``python -m tpudml.obs --check-drift`` — a non-zero exit.
+
+The live regimes mirror tests/test_analysis.py's world-4 LeNet recipe
+exactly (DP/SGD and ZeRO-1/Adam), so a passing drift check reproduces
+the PR 10 acceptance pin. File-based comparison (``drift_from_pairs``)
+covers pre-recorded fixtures and CI gating without a device mesh.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+DRIFT_REPORT_VERSION = 1
+DEFAULT_THRESHOLD = 0.10
+DRIFT_REPORT_PATH = os.path.join("obs", "drift.json")
+
+# Live regimes: name -> engine config. World 4 matches the PR 10 parity
+# pin; adam-under-zero1 exercises the sharded moment update's collectives.
+REGIMES: dict[str, dict] = {
+    "task2_dp": {"zero1": False, "optimizer": "sgd"},
+    "dp_zero1": {"zero1": True, "optimizer": "adam"},
+}
+_WORLD = 4
+
+
+def measure_regime(name: str) -> dict:
+    """One drift record for a live regime: build the engine twice (the
+    measured split-step path and the fused static-analysis path), run one
+    step, compare wire bytes on the shared ring model."""
+    import jax
+    import numpy as np
+
+    from tpudml.analysis.dataflow import analyze_dataflow
+    from tpudml.core.config import MeshConfig
+    from tpudml.core.dist import make_mesh
+    from tpudml.core.prng import seed_key
+    from tpudml.models import LeNet
+    from tpudml.optim import make_optimizer
+    from tpudml.parallel.dp import DataParallel
+
+    cfg = REGIMES[name]
+    if len(jax.devices()) < _WORLD:
+        raise RuntimeError(
+            f"drift regime {name!r} needs a {_WORLD}-device mesh "
+            f"(have {len(jax.devices())}); provision a CPU host platform "
+            "as python -m tpudml.obs does")
+    mesh = make_mesh(MeshConfig({"data": _WORLD}), jax.devices()[:_WORLD])
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(8, 28, 28, 1)).astype(np.float32)
+    y = rng.integers(0, 10, size=(8,)).astype(np.int32)
+
+    measured_dp = DataParallel(
+        LeNet(), make_optimizer(cfg["optimizer"], 0.01), mesh,
+        measure_comm=True, zero1=cfg["zero1"])
+    ts = measured_dp.create_state(seed_key(0))
+    measured_dp.make_train_step()(ts, x, y)
+    measured = float(measured_dp.comm_stats.comm_bytes)
+    comm_time = float(measured_dp.comm_stats.comm_time_s)
+
+    static_dp = DataParallel(
+        LeNet(), make_optimizer(cfg["optimizer"], 0.01), mesh,
+        zero1=cfg["zero1"])
+    ts2 = static_dp.create_state(seed_key(0))
+    fused = static_dp.make_train_step()
+    closed = jax.make_jaxpr(fused.jitted)(ts2, x, y)
+    flow = analyze_dataflow(closed, f"drift-{name}", in_specs=fused.in_specs,
+                            mesh_axes=fused.mesh_axes)
+    static = float(sum(ev.wire_bytes * ev.trips for ev in flow.comm_events))
+    return _record(name, static, measured, measured_comm_time_s=comm_time)
+
+
+def _record(entrypoint: str, static: float, measured: float,
+            **extra: Any) -> dict:
+    rel_err = abs(static - measured) / measured if measured > 0 else (
+        0.0 if static == 0 else float("inf"))
+    return {
+        "entrypoint": entrypoint,
+        "static_wire_bytes": static,
+        "measured_wire_bytes": measured,
+        "rel_err": rel_err,
+        **extra,
+    }
+
+
+def drift_records(names: list[str] | None = None) -> list[dict]:
+    return [measure_regime(n) for n in (names or list(REGIMES))]
+
+
+def drift_from_pairs(pairs: list[dict]) -> list[dict]:
+    """Records from pre-measured (static, measured) pairs — the fixture/
+    CI path. Each pair needs ``entrypoint``, ``static_wire_bytes``,
+    ``measured_wire_bytes``; extra keys ride along."""
+    out = []
+    for p in pairs:
+        extra = {k: v for k, v in p.items()
+                 if k not in ("entrypoint", "static_wire_bytes",
+                              "measured_wire_bytes")}
+        out.append(_record(p["entrypoint"], float(p["static_wire_bytes"]),
+                           float(p["measured_wire_bytes"]), **extra))
+    return out
+
+
+def build_drift_report(records: list[dict],
+                       threshold: float = DEFAULT_THRESHOLD) -> dict:
+    """Stamp each record OK/WARN against ``threshold`` and roll up."""
+    stamped = [dict(r, status="WARN" if r["rel_err"] > threshold else "OK")
+               for r in records]
+    worst = max((r["rel_err"] for r in stamped), default=0.0)
+    return {
+        "version": DRIFT_REPORT_VERSION,
+        "threshold": threshold,
+        "units": "bytes/device (ring model, comm.timing.collective_wire_bytes)",
+        "records": stamped,
+        "worst_rel_err": worst,
+        "ok": all(r["status"] == "OK" for r in stamped),
+    }
+
+
+def write_drift_report(report: dict, path: str = DRIFT_REPORT_PATH) -> str:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(report, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def format_drift_table(report: dict) -> str:
+    lines = [
+        f"{'entrypoint':<16} {'static MB':>10} {'measured MB':>12} "
+        f"{'rel err':>8}  status",
+    ]
+    for r in report["records"]:
+        lines.append(
+            f"{r['entrypoint']:<16} {r['static_wire_bytes'] / 1e6:>10.3f} "
+            f"{r['measured_wire_bytes'] / 1e6:>12.3f} "
+            f"{r['rel_err'] * 100:>7.2f}%  {r['status']}"
+        )
+    lines.append(
+        f"worst {report['worst_rel_err'] * 100:.2f}% vs threshold "
+        f"{report['threshold'] * 100:.0f}% — "
+        + ("OK" if report["ok"] else "DRIFT")
+    )
+    return "\n".join(lines)

@@ -1,0 +1,124 @@
+"""In-graph step telemetry: the :class:`StepStats` pytree.
+
+The engines' jitted steps already return a metrics dict through their
+``out_specs``; with ``obs=`` enabled they additionally return a small
+:class:`StepStats` pytree under ``metrics["step_stats"]`` — loss, global
+gradient norm, the sentinel's device-side skip counters, and accumulated
+ring-model comm bytes — ALL computed inside the existing program (no
+host callbacks, no extra dispatch, no per-step sync). ``train_loop``
+streams the leaves to :class:`MetricsWriter` at its logging cadence,
+where the loss materialization already forces the one host sync.
+
+The comm-bytes leaf is priced at trace time from the gradient/state
+shapes using the same ring model as the static analyzer and the
+measured-path ``CommStats`` (``comm.timing.collective_wire_bytes``),
+baked into the program as a constant and multiplied by the step counter
+— which is why it costs nothing per step and stays comparable with both
+the ``--cost`` reports and the drift monitor.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from tpudml.comm.timing import collective_wire_bytes
+
+PyTree = Any
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class StepStats:
+    """One step's in-graph telemetry; every leaf is a replicated scalar."""
+
+    loss: jax.Array
+    grad_norm: jax.Array
+    skips: jax.Array          # sentinel total skipped steps (0 w/o sentinel)
+    consecutive: jax.Array    # sentinel consecutive-skip counter
+    comm_bytes: jax.Array     # accumulated ring-model wire bytes/device
+
+    def to_scalars(self) -> dict:
+        """Host-side flattening for MetricsWriter/summaries."""
+        return {
+            "loss": self.loss,
+            "grad_norm": self.grad_norm,
+            "sentinel_skips": self.skips,
+            "sentinel_consecutive": self.consecutive,
+            "comm_bytes": self.comm_bytes,
+        }
+
+
+def tree_bytes(tree: PyTree) -> float:
+    """Total payload bytes of a pytree's array leaves (trace-time shapes)."""
+    return float(sum(
+        leaf.size * leaf.dtype.itemsize
+        for leaf in jax.tree.leaves(tree)
+        if hasattr(leaf, "dtype")
+    ))
+
+
+def grad_normsq(grads: PyTree) -> jax.Array:
+    """Sum of squared gradient entries as an f32 scalar (in-graph).
+    Callers apply whatever cross-replica reduction their sharding needs
+    before taking the square root."""
+    leaves = [jnp.sum(jnp.square(g.astype(jnp.float32)))
+              for g in jax.tree.leaves(grads)]
+    return sum(leaves) if leaves else jnp.float32(0.0)
+
+
+def dp_wire_bytes_per_step(
+    grads: PyTree,
+    model_state: PyTree,
+    world: int,
+    aggregation: str = "allreduce",
+    zero1: bool = False,
+) -> float:
+    """Ring-model wire bytes one DP step moves per device, from trace-time
+    shapes: the gradient aggregation (strategy-dependent) plus the
+    model-state pmean. ZeRO-1 replaces aggregation with reduce-scatter +
+    chunk all_gather — same 2·P·(N−1)/N total as psum, which is why the
+    drift monitor sees the two regimes agree with the static reports."""
+    gb = tree_bytes(grads)
+    msb = tree_bytes(model_state)
+    if zero1:
+        agg = (collective_wire_bytes("psum_scatter", gb, world)
+               + collective_wire_bytes("all_gather", gb / max(world, 1), world))
+    elif aggregation == "allgather":
+        agg = collective_wire_bytes("all_gather", gb, world)
+    else:
+        # allreduce; reducescatter's psum_scatter+all_gather decomposition
+        # prices identically to psum (its non-divisible leaves pmean).
+        agg = collective_wire_bytes("psum", gb, world)
+    return agg + collective_wire_bytes("psum", msb, world)
+
+
+def make_step_stats(
+    loss: jax.Array,
+    normsq: jax.Array,
+    opt_state: PyTree,
+    comm_bytes_per_step: float,
+    step: jax.Array,
+) -> StepStats:
+    """Assemble the StepStats pytree inside a traced step body.
+
+    ``opt_state`` is the POST-update optimizer state: when a GradSentinel
+    is in the chain its skip/consecutive counters are read straight from
+    the state tree (pure structure walk — works on tracers); without one
+    the counters are constant zeros.
+    """
+    from tpudml.resilience.sentinel import find_sentinel_state
+
+    st = find_sentinel_state(opt_state)
+    zero = jnp.int32(0)
+    return StepStats(
+        loss=loss.astype(jnp.float32),
+        grad_norm=jnp.sqrt(jnp.maximum(normsq, 0.0)),
+        skips=st["skips"].astype(jnp.int32) if st is not None else zero,
+        consecutive=(st["consecutive"].astype(jnp.int32)
+                     if st is not None else zero),
+        comm_bytes=jnp.float32(comm_bytes_per_step) * (step + 1).astype(jnp.float32),
+    )

@@ -32,6 +32,7 @@ from tpudml.nn.losses import softmax_cross_entropy
 from tpudml.comm.timing import CommStats
 from tpudml.core.dist import process_index
 from tpudml.nn.layers import Module
+from tpudml.obs.tracer import NULL_SPAN, Tracer
 from tpudml.optim import Optimizer, ZeRO1
 from tpudml.parallel.sharding import (
     data_sharding,
@@ -99,6 +100,7 @@ class DataParallel:
         zero1: bool = False,
         zero1_overlap: bool = False,
         sentinel: bool | dict = False,
+        obs: bool | Tracer = False,
     ):
         if save_scores and not fused_xent:
             raise ValueError("save_scores requires fused_xent=True")
@@ -168,6 +170,19 @@ class DataParallel:
         self.accum_steps = accum_steps
         self.comm_stats = CommStats()
         self.world = mesh.shape[axis_name]
+        # Observability (tpudml.obs, one knob): obs=True builds a fresh
+        # Tracer, an existing Tracer passes through. The tracer receives
+        # one "step" span per dispatched step plus every measured comm
+        # span (via comm_stats.tracer), and the jitted step additionally
+        # returns the in-graph StepStats pytree under
+        # metrics["step_stats"] — no host callbacks, so the fused step
+        # stays one program and the off position allocates zero spans.
+        self.tracer: Tracer | None = None
+        self._obs_stats = False
+        if obs:
+            self.tracer = obs if isinstance(obs, Tracer) else Tracer()
+            self._obs_stats = True
+            self.comm_stats.tracer = self.tracer
         # ZeRO-1 (arXiv 2004.13336): wrap the optimizer so it reduce-
         # scatters grads and updates a 1/N param/state shard per chip
         # (see tpudml.optim.zero1). ``zero1_overlap`` additionally keeps
@@ -353,6 +368,41 @@ class DataParallel:
             return self._make_split_step()
         return self._make_fused_step()
 
+    def _obs_span(self, name: str):
+        """The per-dispatch tracer span; a shared no-op object when obs
+        is off (the hot path must not allocate per step)."""
+        if self.tracer is None:
+            return NULL_SPAN
+        return self.tracer.span(name, cat="step")
+
+    def _obs_step_stats(self, metrics: dict, grads, model_state, new_opt, step):
+        """Append the in-graph StepStats pytree to the step's metrics
+        (obs mode only). Under zero1 the optimizer-boundary grads are the
+        PRE-reduce-scatter per-replica grads, so the reported norm is the
+        RMS of per-replica gradient norms (pmean of the squared norms) —
+        an upper bound on the true mean-grad norm; plain DP reports the
+        exact global norm of the aggregated gradient."""
+        if not self._obs_stats:
+            return metrics
+        from tpudml.obs.stepstats import (
+            dp_wire_bytes_per_step,
+            grad_normsq,
+            make_step_stats,
+        )
+
+        normsq = grad_normsq(grads)
+        if self.zero1:
+            normsq = jax.lax.pmean(normsq, self.axis_name)
+        bps = dp_wire_bytes_per_step(
+            grads, model_state, self.world,
+            aggregation=self.aggregation, zero1=self.zero1,
+        )
+        metrics = dict(metrics)
+        metrics["step_stats"] = make_step_stats(
+            metrics["loss"], normsq, new_opt, bps, step
+        )
+        return metrics
+
     def _agg_metrics(self, local: dict) -> dict:
         """Cross-replica metric aggregation: means, except the sentinel's
         ``bad_micro`` index which is a max (-1 means clean; a mean over
@@ -398,6 +448,7 @@ class DataParallel:
         model_state = pmean_tree(model_state, self.axis_name)
         new_params, new_opt = self.optimizer.update(grads, ts.opt_state, ts.params)
         metrics = self._agg_metrics(local)
+        metrics = self._obs_step_stats(metrics, grads, model_state, new_opt, ts.step)
         new_ts = TrainState(
             params=new_params,
             model_state=model_state,
@@ -437,6 +488,7 @@ class DataParallel:
         model_state = pmean_tree(model_state, self.axis_name)
         new_chunks, new_opt = opt.update_shards(grads, ts.opt_state, ts.params)
         metrics = self._agg_metrics(local)
+        metrics = self._obs_step_stats(metrics, grads, model_state, new_opt, ts.step)
         new_ts = TrainState(
             params=new_chunks,
             model_state=model_state,
@@ -469,8 +521,9 @@ class DataParallel:
 
         def step(ts: TrainState, images, labels):
             images, labels = self.shard_batch(images, labels)
-            out = jitted(ts, images, labels)
-            self._throttle.after_step(out[1]["loss"])
+            with self._obs_span("train_step"):
+                out = jitted(ts, images, labels)
+                self._throttle.after_step(out[1]["loss"])
             return out
 
         # Expose the raw program for tpudml.analysis: the wrapper above
@@ -549,31 +602,53 @@ class DataParallel:
 
         def step(ts: TrainState, images, labels):
             images, labels = self.shard_batch(images, labels)
-            stacked_grads, stacked_state, stacked_metrics = grad_fn(ts, images, labels)
-            jax.block_until_ready(stacked_grads)
-            if (
-                self.bottleneck_rank is not None
-                and process_index() == self.bottleneck_rank % max(jax.process_count(), 1)
-            ):
-                # Straggler injection: this host enters the collective late
-                # (reference: time.sleep(bottle_neck_delay) on one rank,
-                # model-mp.py:47,64-65). In synchronous SPMD the whole step
-                # inherits the delay — the effect task2 asks students to
-                # observe (sections/checking.tex:22).
-                time.sleep(self.bottleneck_delay_s)
-            t0 = time.perf_counter()
-            grads, model_state = agg_fn(stacked_grads, stacked_state)
-            jax.block_until_ready(grads)
-            if not wire_bytes_cache:
-                wire_bytes_cache.append(
-                    _program_wire_bytes(agg_fn, stacked_grads, stacked_state))
-            self.comm_stats.add(time.perf_counter() - t0,
-                                nbytes=wire_bytes_cache[0])
-            new_ts = apply_fn(ts, grads, model_state)
-            metrics = {
-                "loss": jnp.mean(stacked_metrics["loss"]),
-                "accuracy": jnp.mean(stacked_metrics["accuracy"]),
-            }
+            with self._obs_span("train_step"):
+                stacked_grads, stacked_state, stacked_metrics = grad_fn(
+                    ts, images, labels)
+                jax.block_until_ready(stacked_grads)
+                if (
+                    self.bottleneck_rank is not None
+                    and process_index() == self.bottleneck_rank % max(jax.process_count(), 1)
+                ):
+                    # Straggler injection: this host enters the collective late
+                    # (reference: time.sleep(bottle_neck_delay) on one rank,
+                    # model-mp.py:47,64-65). In synchronous SPMD the whole step
+                    # inherits the delay — the effect task2 asks students to
+                    # observe (sections/checking.tex:22).
+                    time.sleep(self.bottleneck_delay_s)
+                t0 = time.perf_counter()
+                grads, model_state = agg_fn(stacked_grads, stacked_state)
+                jax.block_until_ready(grads)
+                if not wire_bytes_cache:
+                    wire_bytes_cache.append(
+                        _program_wire_bytes(agg_fn, stacked_grads, stacked_state))
+                self.comm_stats.add(time.perf_counter() - t0,
+                                    nbytes=wire_bytes_cache[0])
+                new_ts = apply_fn(ts, grads, model_state)
+                metrics = {
+                    "loss": jnp.mean(stacked_metrics["loss"]),
+                    "accuracy": jnp.mean(stacked_metrics["accuracy"]),
+                }
+                if self._obs_stats:
+                    # Split mode is already the measurability-over-fusion
+                    # trade, so StepStats assembles HOST-side here from
+                    # the aggregated grads (the fused paths bake it into
+                    # the program instead).
+                    from tpudml.obs.stepstats import (
+                        dp_wire_bytes_per_step,
+                        grad_normsq,
+                        make_step_stats,
+                    )
+
+                    metrics["step_stats"] = make_step_stats(
+                        metrics["loss"], grad_normsq(grads),
+                        new_ts.opt_state,
+                        dp_wire_bytes_per_step(
+                            grads, model_state, self.world,
+                            aggregation=self.aggregation,
+                        ),
+                        ts.step,
+                    )
             return new_ts, metrics
 
         # The three device programs, exposed for tpudml.analysis (the
@@ -662,28 +737,50 @@ class DataParallel:
 
         def step(ts: TrainState, images, labels):
             images, labels = self.shard_batch(images, labels)
-            stacked_grads, stacked_state, stacked_metrics = grad_fn(
-                ts, images, labels
-            )
-            jax.block_until_ready(stacked_grads)
-            if (
-                self.bottleneck_rank is not None
-                and process_index()
-                == self.bottleneck_rank % max(jax.process_count(), 1)
-            ):
-                time.sleep(self.bottleneck_delay_s)
-            t0 = time.perf_counter()
-            new_ts = ex_fn(ts, stacked_grads, stacked_state)
-            jax.block_until_ready(new_ts.params)
-            if not wire_bytes_cache:
-                wire_bytes_cache.append(_program_wire_bytes(
-                    ex_fn, ts, stacked_grads, stacked_state))
-            self.comm_stats.add(time.perf_counter() - t0,
-                                nbytes=wire_bytes_cache[0])
-            metrics = {
-                "loss": jnp.mean(stacked_metrics["loss"]),
-                "accuracy": jnp.mean(stacked_metrics["accuracy"]),
-            }
+            with self._obs_span("train_step"):
+                stacked_grads, stacked_state, stacked_metrics = grad_fn(
+                    ts, images, labels
+                )
+                jax.block_until_ready(stacked_grads)
+                if (
+                    self.bottleneck_rank is not None
+                    and process_index()
+                    == self.bottleneck_rank % max(jax.process_count(), 1)
+                ):
+                    time.sleep(self.bottleneck_delay_s)
+                t0 = time.perf_counter()
+                new_ts = ex_fn(ts, stacked_grads, stacked_state)
+                jax.block_until_ready(new_ts.params)
+                if not wire_bytes_cache:
+                    wire_bytes_cache.append(_program_wire_bytes(
+                        ex_fn, ts, stacked_grads, stacked_state))
+                self.comm_stats.add(time.perf_counter() - t0,
+                                    nbytes=wire_bytes_cache[0])
+                metrics = {
+                    "loss": jnp.mean(stacked_metrics["loss"]),
+                    "accuracy": jnp.mean(stacked_metrics["accuracy"]),
+                }
+                if self._obs_stats:
+                    # Host-side StepStats from the PRE-reduce-scatter
+                    # per-replica grads: the mean of per-replica norm² is
+                    # the zero1 RMS-norm convention (_obs_step_stats).
+                    from tpudml.obs.stepstats import (
+                        dp_wire_bytes_per_step,
+                        grad_normsq,
+                        make_step_stats,
+                    )
+
+                    g0 = jax.tree.map(lambda g: g[0], stacked_grads)
+                    s0 = jax.tree.map(lambda s: s[0], stacked_state)
+                    metrics["step_stats"] = make_step_stats(
+                        metrics["loss"],
+                        grad_normsq(stacked_grads) / self.world,
+                        new_ts.opt_state,
+                        dp_wire_bytes_per_step(
+                            g0, s0, self.world, zero1=True
+                        ),
+                        ts.step,
+                    )
             return new_ts, metrics
 
         step.programs = (grad_fn, ex_fn)

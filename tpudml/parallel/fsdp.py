@@ -115,6 +115,7 @@ class FSDP(GSPMDParallel):
         fused_xent: bool = False,
         save_scores: bool | None = None,
         sentinel: bool | dict = False,
+        obs=False,
     ):
         if axis_name not in mesh.shape:
             raise ValueError(
@@ -136,4 +137,5 @@ class FSDP(GSPMDParallel):
             fused_xent=fused_xent,
             save_scores=save_scores,
             sentinel=sentinel,
+            obs=obs,
         )

@@ -40,6 +40,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpudml.nn.layers import Module
 from tpudml.nn.losses import softmax_cross_entropy
+from tpudml.obs.tracer import NULL_SPAN, Tracer
 from tpudml.optim import Optimizer
 from tpudml.parallel.sharding import DispatchThrottle
 from tpudml.train import (
@@ -180,6 +181,7 @@ class GSPMDParallel:
         fused_xent: bool = False,
         save_scores: bool | None = None,
         sentinel: bool | dict = False,
+        obs: bool | Tracer = False,
     ):
         if save_scores and not fused_xent:
             raise ValueError("save_scores requires fused_xent=True")
@@ -225,6 +227,17 @@ class GSPMDParallel:
         self._aux_loss_weight = aux_loss_weight
         self._specs = None  # computed at create_state
         self._throttle = DispatchThrottle(mesh)
+        # Observability (tpudml.obs, same knob as the DP engine): one
+        # "step" span per dispatch plus the in-graph StepStats pytree in
+        # metrics. ``comm_bytes`` stays 0 here — this engine's collectives
+        # are inserted by the SPMD partitioner at compile time, so no
+        # body-level ring-model price exists (the static analyzer has the
+        # same blind spot; see make_train_step's note).
+        self.tracer: Tracer | None = None
+        self._obs_stats = False
+        if obs:
+            self.tracer = obs if isinstance(obs, Tracer) else Tracer()
+            self._obs_stats = True
 
     # ---------------------------------------------------------------- state
 
@@ -301,6 +314,15 @@ class GSPMDParallel:
                     rng, self.accum_steps, taint=self.sentinel is not None,
                 )
             new_params, new_opt = self.optimizer.update(grads, ts.opt_state, ts.params)
+            if self._obs_stats:
+                from tpudml.obs.stepstats import grad_normsq, make_step_stats
+
+                # Grads here are logically global arrays, so this is the
+                # exact global grad norm (XLA inserts the reductions).
+                metrics = dict(metrics)
+                metrics["step_stats"] = make_step_stats(
+                    metrics["loss"], grad_normsq(grads), new_opt, 0.0, ts.step
+                )
             new_ts = TrainState(
                 params=new_params,
                 model_state=model_state,
@@ -323,8 +345,9 @@ class GSPMDParallel:
         def step(ts: TrainState, images, labels):
             images = jax.device_put(jnp.asarray(images), batch_sharding)
             labels = jax.device_put(jnp.asarray(labels), batch_sharding)
-            out = jitted(ts, images, labels)
-            self._throttle.after_step(out[1]["loss"])
+            with self._obs_span("train_step"):
+                out = jitted(ts, images, labels)
+                self._throttle.after_step(out[1]["loss"])
             return out
 
         # Raw program for tpudml.analysis (wrapper does host-side work).
@@ -336,6 +359,13 @@ class GSPMDParallel:
         step.in_specs = (self._specs, batch_spec, batch_spec)
         step.mesh_axes = dict(self.mesh.shape)
         return step
+
+    def _obs_span(self, name: str):
+        """Per-dispatch tracer span; a shared no-op object when obs is
+        off (the hot path must not allocate per step)."""
+        if self.tracer is None:
+            return NULL_SPAN
+        return self.tracer.span(name, cat="step")
 
     # ------------------------------------------------------------- evaluate
 

@@ -142,6 +142,7 @@ class GPipe:
         remat: bool = False,
         batch_axis: str | None = None,
         sentinel: bool | dict = False,
+        obs=False,
     ):
         self.block = block
         self.remat = remat
@@ -207,11 +208,57 @@ class GPipe:
         self.epilogue = epilogue
         self.loss = loss
         self._throttle = DispatchThrottle(mesh)
+        # Observability (tpudml.obs, same knob as the DP/GSPMD engines):
+        # one "step" span per dispatch plus the in-graph StepStats pytree
+        # under metrics["step_stats"]. comm_bytes stays 0 — the schedule's
+        # ppermute traffic is a schedule property, not a per-step ring-
+        # model constant (the static analyzer prices it; see --cost).
+        from tpudml.obs.tracer import Tracer as _Tracer
+
+        self.tracer = None
+        self._obs_stats = False
+        if obs:
+            self.tracer = obs if isinstance(obs, _Tracer) else _Tracer()
+            self._obs_stats = True
 
     def _batch_spec(self) -> P:
         """Spec for batch-shaped arrays: sharded over the data axis when
         composing with DP, replicated otherwise."""
         return P(self.batch_axis) if self.batch_axis else P()
+
+    def _obs_span(self, name: str):
+        """Per-dispatch tracer span; a shared no-op object when obs is
+        off (the hot path must not allocate per step)."""
+        if self.tracer is None:
+            from tpudml.obs.tracer import NULL_SPAN
+
+            return NULL_SPAN
+        return self.tracer.span(name, cat="step")
+
+    def _obs_step_stats(self, metrics: dict, grads, new_opt, step):
+        """Append the in-graph StepStats pytree to the step's metrics
+        (obs mode only; shared by all three schedule bodies). Stage grads
+        are stage-local shards and prologue/epilogue grads replicated
+        over the stage axis, so the stage norm² psums once and the
+        replicated parts add once — the exact global grad norm. Under
+        ZeRO-1 PP×DP the optimizer-boundary grads are per-data-replica;
+        the pmean makes the report the RMS of per-replica norms (the DP
+        engine's zero1 convention)."""
+        if not self._obs_stats:
+            return metrics
+        from tpudml.obs.stepstats import grad_normsq, make_step_stats
+
+        normsq = lax.psum(grad_normsq(grads["stages"]), self.axis_name)
+        normsq = normsq + grad_normsq(
+            {"prologue": grads["prologue"], "epilogue": grads["epilogue"]}
+        )
+        if self.batch_axis and zero1_handles(self.optimizer, self.batch_axis):
+            normsq = lax.pmean(normsq, self.batch_axis)
+        metrics = dict(metrics)
+        metrics["step_stats"] = make_step_stats(
+            metrics["loss"], normsq, new_opt, 0.0, step
+        )
+        return metrics
 
     # ---------------------------------------------------------------- params
 
@@ -392,6 +439,7 @@ class GPipe:
                 k: lax.pmean(v, self.batch_axis) for k, v in metrics.items()
             }
         new_params, new_opt = self.optimizer.update(grads, ts.opt_state, ts.params)
+        metrics = self._obs_step_stats(metrics, grads, new_opt, ts.step)
         new_ts = TrainState(
             params=new_params,
             model_state=ts.model_state,
@@ -422,8 +470,9 @@ class GPipe:
         )
 
         def step(ts: TrainState, x, labels):
-            out = jitted(ts, jnp.asarray(x), jnp.asarray(labels))
-            self._throttle.after_step(out[1]["loss"])
+            with self._obs_span("train_step"):
+                out = jitted(ts, jnp.asarray(x), jnp.asarray(labels))
+                self._throttle.after_step(out[1]["loss"])
             return out
 
         # Raw program for tpudml.analysis (wrapper does host-side work);
@@ -681,6 +730,7 @@ class OneFOneB(GPipe):
                 k: lax.pmean(v, self.batch_axis) for k, v in metrics.items()
             }
         new_params, new_opt = self.optimizer.update(grads, ts.opt_state, ts.params)
+        metrics = self._obs_step_stats(metrics, grads, new_opt, ts.step)
         new_ts = TrainState(
             params=new_params,
             model_state=ts.model_state,
@@ -1346,6 +1396,7 @@ class Interleaved1F1B(GPipe):
                 k: lax.pmean(v, self.batch_axis) for k, v in metrics.items()
             }
         new_params, new_opt = self.optimizer.update(grads, ts.opt_state, ts.params)
+        metrics = self._obs_step_stats(metrics, grads, new_opt, ts.step)
         new_ts = TrainState(
             params=new_params,
             model_state=ts.model_state,

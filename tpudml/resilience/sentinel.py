@@ -311,6 +311,19 @@ def sentinel_hook(
             idx = int(metrics["bad_micro"])
             if idx >= 0:
                 micro = f", first poisoned microbatch {idx}"
+        from tpudml.obs.tracer import get_tracer
+
+        # Ambient flight recorder (tpudml.obs): the trip lands on the
+        # trace as an instant before the raise unwinds the train loop.
+        get_tracer().instant(
+            "sentinel_trip", cat="sentinel",
+            args={
+                "step": int(step),
+                "consecutive": consecutive,
+                "skips": int(st["skips"]),
+                "bad_leaf": leaf,
+            },
+        )
         raise SentinelTripped(
             f"sentinel skipped {consecutive} consecutive steps "
             f"(budget {sentinel.skip_budget}) at step {step}: first "

@@ -604,6 +604,18 @@ def train_loop(
                 loss = float(metrics["loss"])
                 if writer is not None:
                     writer.add_scalar("Train Loss", loss, counter)
+                    stats = metrics.get("step_stats")
+                    if stats is not None and hasattr(stats, "to_scalars"):
+                        # In-graph telemetry (tpudml.obs): the StepStats
+                        # pytree streams as obs/* scalars on the same
+                        # cadence as the loss.
+                        writer.add_scalars(
+                            {
+                                f"obs/{k}": float(v)
+                                for k, v in stats.to_scalars().items()
+                            },
+                            counter,
+                        )
                 print(f"epoch {epoch} iter {counter}: loss {loss:.4f}")
             for h in hooks or ():
                 h(epoch=epoch, step=counter, train_state=ts, metrics=metrics)
@@ -613,7 +625,16 @@ def train_loop(
     if writer is not None:
         writer.add_scalar("Train Time", train_time, counter)
     last_metrics = (
-        {k: float(v) for k, v in metrics.items()} if metrics is not None else {}
+        {
+            k: (
+                {kk: float(vv) for kk, vv in v.to_scalars().items()}
+                if hasattr(v, "to_scalars")  # obs StepStats pytree
+                else float(v)
+            )
+            for k, v in metrics.items()
+        }
+        if metrics is not None
+        else {}
     )
     last_metrics["train_time_s"] = train_time
     last_metrics["steps"] = counter
