@@ -594,6 +594,95 @@ def main_moe() -> None:
     print(json.dumps(bench_moe(on_tpu)))
 
 
+def bench_plan(world: int) -> dict:
+    """``--plan`` mode: planner rank order vs measured step times.
+
+    Measures the dryrun weight-update regimes (the ``--zero1`` engine
+    set: DP-replicated, ZeRO-1, ZeRO-1+overlap, FSDP — all fused-xent on
+    the same ``{"data": world}`` mesh, same flagship LM, same global
+    batch) by building each one THROUGH the planner's own
+    ``build_candidate``, so the program timed is exactly the program the
+    emitted plan describes. The planner then scores the same four
+    candidates; the report carries both orderings and the acceptance
+    ratio: measured time of the planner's top-1 over the measured best.
+    The planner validation test pins ``within_tolerance`` (<= 1.10).
+    """
+    from tpudml.plan.emit import build_candidate
+    from tpudml.plan.score import score_candidate
+    from tpudml.plan.space import Candidate, flagship_lm
+
+    spec = flagship_lm()
+    mesh = (("data", world),)
+
+    def cand(engine, zero1=False, overlap=False, accum=1):
+        return Candidate(
+            engine=engine, mesh=mesh, zero1=zero1, zero1_overlap=overlap,
+            accum_steps=accum, fused_xent=True, sentinel=False, obs=False,
+        )
+
+    named = {
+        "dp_replicated": cand("dp"),
+        "dp_zero1": cand("zero1", zero1=True),
+        "dp_zero1_overlap": cand("zero1", zero1=True, overlap=True, accum=2),
+        "fsdp": cand("fsdp"),
+    }
+    rows: dict[str, dict] = {}
+    for name, c in named.items():
+        score = score_candidate(spec, c)
+        _, ts, step, (x, y) = build_candidate(spec, c)
+        sec = _time_pipelined(step, ts, (x, y), iters=6)
+        rows[name] = {
+            "candidate": c.key(),
+            "sec_per_step": round(sec, 6),
+            "planner_per_token_s": score.per_token_s,
+        }
+    planner_order = sorted(
+        named, key=lambda n: (rows[n]["planner_per_token_s"], n))
+    measured_order = sorted(named, key=lambda n: rows[n]["sec_per_step"])
+    for i, n in enumerate(planner_order, 1):
+        rows[n]["planner_rank"] = i
+    for i, n in enumerate(measured_order, 1):
+        rows[n]["measured_rank"] = i
+    top1, best = planner_order[0], measured_order[0]
+    ratio = rows[top1]["sec_per_step"] / rows[best]["sec_per_step"]
+    return {
+        "metric": "planner_rank_validation",
+        "config": {**spec.to_dict(), "world": world, "fused_xent": True,
+                   "optimizer": "adamw"},
+        "protocol": "pipelined_relative",
+        "rows": rows,
+        "planner_order": planner_order,
+        "measured_order": measured_order,
+        "planner_top1": top1,
+        "measured_best": best,
+        "top1_vs_best_ratio": round(ratio, 4),
+        "tolerance": 1.10,
+        "within_tolerance": ratio <= 1.10,
+    }
+
+
+def main_plan() -> None:
+    """Driver for ``python bench.py --plan [--world N]``: prints ONE JSON
+    line, same contract as ``main()``, for the planner rank validation.
+    Self-provisions an 8-device CPU mesh when no accelerator is visible
+    (same dance as ``--zero1``)."""
+    import os
+    import sys
+
+    if "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""
+    ) and not os.environ.get("TPU_NAME"):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    argv = sys.argv[1:]
+    world = jax.device_count()
+    if "--world" in argv:
+        world = min(int(argv[argv.index("--world") + 1]), jax.device_count())
+    print(json.dumps(bench_plan(world)))
+
+
 def bench_serve(on_tpu, smoke=False) -> dict:
     """``--serve`` report for the multi-tenant serving tier.
 
@@ -1189,6 +1278,8 @@ if __name__ == "__main__":
     # line); the bare invocation's driver contract is untouched.
     if "--zero1" in sys.argv[1:]:
         main_zero1()
+    elif "--plan" in sys.argv[1:]:
+        main_plan()
     elif "--moe" in sys.argv[1:]:
         main_moe()
     elif "--serve" in sys.argv[1:]:

@@ -46,6 +46,7 @@ import time
 import jax
 import numpy as np
 
+from tpudml.capabilities import reject
 from tpudml.core.config import MeshConfig
 from tpudml.core.dist import assert_same_program, distributed_init, make_mesh
 from tpudml.core.prng import seed_key
@@ -189,11 +190,7 @@ def build_engine(args, devices):
         # single/dp/cp run the token-parallel kernel per shard; tp/fsdp
         # run the vocab-sharded form (per-shard partial statistics
         # merged by the online log-sum-exp rule; see docs/API.md).
-        raise ValueError(
-            "--fused_xent does not compose with --parallel pp: the "
-            "pipeline epilogue ships logits between stages, so there "
-            "is no feature tensor for the fused head to consume"
-        )
+        reject("pp_fused_xent")
     scores = getattr(args, "fused_xent_scores", False)
     lean = getattr(args, "fused_xent_lean", False)
     if (scores or lean) and not args.fused_xent:
@@ -249,7 +246,7 @@ def build_engine(args, devices):
                 f"--moe_experts {args.moe_experts} must divide over {n} devices"
             )
         if args.dropout:
-            raise ValueError("--parallel ep does not support --dropout")
+            reject("ep_dropout")
         from tpudml.parallel.ep import ExpertParallel
 
         mesh = make_mesh(MeshConfig({"expert": n}), devices)
@@ -313,7 +310,7 @@ def build_engine(args, devices):
         # interleaves backwards (S in-flight activations instead of M)
         # and supports --dropout via per-(stage, micro) rng keys.
         if args.moe_experts:
-            raise ValueError("--parallel pp does not support --moe_experts")
+            reject("pp_moe")
         if args.dropout and args.schedule not in ("1f1b", "interleaved"):
             raise ValueError(
                 "--dropout pipelines need --schedule 1f1b or interleaved"

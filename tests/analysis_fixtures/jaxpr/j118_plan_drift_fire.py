@@ -1,0 +1,33 @@
+"""J118 firing: the "emitted plan" promises a tiny program (a handful
+of wire bytes, a few KB peak-live) but the traced step psums a 256 KB
+gradient-sized buffer and materialises a ~1 MB intermediate — both
+traced costs deviate far beyond the 10% drift tolerance, so the plan
+no longer describes the program that runs."""
+
+RULE = "J118"
+EXPECT = "fire"
+ANALYZE_KWARGS = {
+    "plan": {
+        "predicted": {"comm_wire_bytes": 64.0, "peak_hbm_bytes": 4096},
+    },
+}
+
+
+def build():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from tpudml.core.config import MeshConfig
+    from tpudml.core.dist import make_mesh
+    from tpudml.parallel.sharding import shard_map_fn
+
+    mesh = make_mesh(MeshConfig({"data": 2}), jax.devices()[:2])
+
+    def body(x):
+        big = jnp.outer(x, x)  # 512*512*4 = 1 MB live
+        g = big.sum(axis=0)  # 512*4*... per-shard "gradient"
+        return jax.lax.psum(g, "data")
+
+    fn = jax.jit(shard_map_fn(body, mesh, in_specs=(P(),), out_specs=P()))
+    return fn, (jnp.ones((512,)),)

@@ -1,0 +1,52 @@
+"""J118 silent twin: the plan's ``predicted`` block is computed at
+import time from the SAME analysis APIs the planner stamps it with
+(dataflow walk for wire bytes, liveness walk for peak HBM) — a fresh
+plan is within tolerance of its own trace by construction, whatever the
+estimators currently say."""
+
+RULE = "J118"
+EXPECT = "silent"
+
+
+def _build_fn():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from tpudml.core.config import MeshConfig
+    from tpudml.core.dist import make_mesh
+    from tpudml.parallel.sharding import shard_map_fn
+
+    mesh = make_mesh(MeshConfig({"data": 2}), jax.devices()[:2])
+
+    def body(x):
+        big = jnp.outer(x, x)
+        g = big.sum(axis=0)
+        return jax.lax.psum(g, "data")
+
+    fn = jax.jit(shard_map_fn(body, mesh, in_specs=(P(),), out_specs=P()))
+    return fn, (jnp.ones((512,)),)
+
+
+def _predict():
+    import jax
+
+    from tpudml.analysis.cost import peak_live_bytes
+    from tpudml.analysis.dataflow import analyze_dataflow
+
+    fn, args = _build_fn()
+    closed = jax.make_jaxpr(fn)(*args)
+    flow = analyze_dataflow(closed, "j118_silent")
+    return {
+        "comm_wire_bytes": float(
+            sum(ev.wire_bytes * ev.trips for ev in flow.comm_events)
+        ),
+        "peak_hbm_bytes": int(peak_live_bytes(closed)),
+    }
+
+
+ANALYZE_KWARGS = {"plan": {"predicted": _predict()}}
+
+
+def build():
+    return _build_fn()

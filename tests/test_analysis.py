@@ -515,7 +515,7 @@ def test_jaxpr_fixture_dir_covers_every_dataflow_rule():
     """Each dataflow rule ships a firing seeded-bug fixture AND a silent
     correct-code twin; a deleted fixture file fails here by rule name."""
     names = _jaxpr_fixture_names()
-    for rule in ("j112", "j113", "j114", "j115", "j116", "j117"):
+    for rule in ("j112", "j113", "j114", "j115", "j116", "j117", "j118"):
         kinds = {n.rsplit("_", 1)[1] for n in names if n.startswith(rule)}
         assert kinds == {"fire", "silent"}, (rule, kinds)
 

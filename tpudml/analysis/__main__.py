@@ -83,6 +83,10 @@ def main(argv: list[str] | None = None) -> int:
                         help="arm J116: flag entrypoints whose static "
                              "peak-live-buffer estimate exceeds this many "
                              "megabytes")
+    parser.add_argument("--plan", default=None, metavar="PLAN_JSON",
+                        help="arm J118: re-trace the plan's winning "
+                             "entrypoint and flag traced comm/HBM that "
+                             "deviates >10%% from its predicted block")
     parser.add_argument("--entrypoints", default=None, metavar="A,B",
                         help="comma-separated jaxpr entrypoints "
                              "(default: all; see --list-rules)")
@@ -162,6 +166,11 @@ def main(argv: list[str] | None = None) -> int:
         from tpudml.analysis.entrypoints import analyze_entrypoints
 
         findings.extend(analyze_entrypoints(names, hbm_budget_bytes))
+    if args.plan:
+        _provision_devices()
+        from tpudml.plan import load_plan, plan_drift_findings
+
+        findings.extend(plan_drift_findings(load_plan(args.plan)))
 
     from tpudml.analysis.allowlist import (
         load_allowlist,

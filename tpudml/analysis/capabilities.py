@@ -1,0 +1,25 @@
+"""Analysis-side face of the engine capability table.
+
+The table itself lives in the dependency-free :mod:`tpudml.capabilities`
+(the engines import it at module top, and importing anything under
+``tpudml.analysis`` from an engine would cycle through
+``analysis.entrypoints`` back into the engines).  The planner and the
+analysis CLI import it from here so the public API stays where the
+rule catalogue lives.
+"""
+
+from tpudml.capabilities import (
+    TABLE,
+    Capability,
+    CompositionError,
+    candidate_rejection,
+    reject,
+)
+
+__all__ = [
+    "TABLE",
+    "Capability",
+    "CompositionError",
+    "candidate_rejection",
+    "reject",
+]

@@ -197,6 +197,52 @@ def check_hbm_budget(
     )]
 
 
+def check_plan_drift(
+    cost: EntrypointCost,
+    plan: dict,
+    threshold: float | None = None,
+) -> list[Finding]:
+    """J118: traced comm/HBM vs the emitted plan's ``predicted`` block.
+
+    ``plan`` is a plan.json document (or any dict with a ``predicted``
+    record); the tolerance defaults to the same 10% the obs drift
+    monitor gates on (``tpudml.obs.drift.DEFAULT_THRESHOLD``) — one
+    knob for "how far may static and truth diverge", everywhere.
+    Relative error is measured against the predicted value; a predicted
+    value of 0 with a nonzero traced one counts as full drift.
+    """
+    if threshold is None:
+        from tpudml.obs.drift import DEFAULT_THRESHOLD
+
+        threshold = DEFAULT_THRESHOLD
+    predicted = (plan or {}).get("predicted") or {}
+    findings: list[Finding] = []
+    checks = (
+        ("comm_wire_bytes", "collective wire bytes",
+         float(cost.total_wire_bytes)),
+        ("peak_hbm_bytes", "peak-live HBM bytes",
+         float(cost.peak_hbm_bytes)),
+    )
+    for key, label, traced in checks:
+        if key not in predicted:
+            continue
+        pred = float(predicted[key])
+        if pred == traced:
+            continue
+        rel = abs(traced - pred) / pred if pred else float("inf")
+        if rel <= threshold:
+            continue
+        findings.append(Finding(
+            "J118",
+            f"traced {label} {traced:.0f} deviates "
+            f"{rel * 100:.0f}% from the plan's predicted {pred:.0f} "
+            f"(tolerance {threshold * 100:.0f}%) — the emitted plan no "
+            f"longer describes this program; re-plan or allowlist",
+            entrypoint=cost.entrypoint,
+        ))
+    return findings
+
+
 def build_cost_report(costs: list[EntrypointCost]) -> dict[str, Any]:
     """The ``analysis/cost_report.json`` document."""
     return {

@@ -62,6 +62,9 @@ RULES: dict[str, tuple[str, str]] = {
     "J117": (WARN, "paged-decode-marked program attends over the FULL page "
                    "pool per token (softmax keyed on num_pages·page_size "
                    "rows instead of the slot's max_pages table rows)"),
+    "J118": (WARN, "traced collectives/HBM deviate >10% from the emitted "
+                   "plan's predicted cost (the plan.json no longer "
+                   "describes the program that runs)"),
     "A201": (WARN, "Python for/if over a traced (jnp/lax) value"),
     "A202": (WARN, "jax.random key consumed more than once without split"),
     "A203": (WARN, "epoch loop iterates a loader without set_epoch"),
@@ -106,6 +109,9 @@ HINTS: dict[str, str] = {
             "(serve.paged.read_table: pool[table] → [B, max_pages·P, ...]) "
             "so attention cost scales with per-slot capacity, not pool "
             "size",
+    "J118": "re-plan (python -m tpudml.plan) so plan.json matches the "
+            "current program, or allowlist the entry with the reason the "
+            "drift is intended",
     "A201": "use lax.cond/lax.fori_loop/jnp.where, or materialize with "
             "float(...) first if this is host-side code",
     "A202": "key, sub = jax.random.split(key) before the second use",

@@ -772,13 +772,19 @@ def analyze_closed_jaxpr(
     in_specs=None,
     mesh_axes: dict[str, int] | None = None,
     hbm_budget_bytes: int | None = None,
+    plan: dict | None = None,
 ) -> list[Finding]:
-    """All jaxpr-level findings (J101-J105, J107-J116) for one traced
+    """All jaxpr-level findings (J101-J105, J107-J118) for one traced
     program: the local pattern rules plus the replication-lattice
     dataflow rules. ``in_specs``/``mesh_axes`` seed the interpreter's
     top-level states (engines attach them to their jitted steps);
-    ``hbm_budget_bytes`` arms J116."""
-    from tpudml.analysis.cost import check_hbm_budget, summarize_cost
+    ``hbm_budget_bytes`` arms J116; ``plan`` (a plan.json document)
+    arms J118 — traced comm/HBM vs the plan's ``predicted`` block."""
+    from tpudml.analysis.cost import (
+        check_hbm_budget,
+        check_plan_drift,
+        summarize_cost,
+    )
     from tpudml.analysis.dataflow import analyze_dataflow
 
     findings: list[Finding] = []
@@ -787,9 +793,12 @@ def analyze_closed_jaxpr(
     flow = analyze_dataflow(closed, entrypoint, in_specs=in_specs,
                             mesh_axes=mesh_axes)
     findings.extend(flow.findings)
-    if hbm_budget_bytes:
+    if hbm_budget_bytes or plan is not None:
         cost = summarize_cost(entrypoint, flow, closed)
-        findings.extend(check_hbm_budget(cost, hbm_budget_bytes))
+        if hbm_budget_bytes:
+            findings.extend(check_hbm_budget(cost, hbm_budget_bytes))
+        if plan is not None:
+            findings.extend(check_plan_drift(cost, plan))
     return findings
 
 
@@ -862,6 +871,7 @@ def analyze_callable(
     in_specs=None,
     mesh_axes: dict[str, int] | None = None,
     hbm_budget_bytes: int | None = None,
+    plan: dict | None = None,
 ) -> list[Finding]:
     """Trace ``fn(*args)`` abstractly and run every jaxpr rule on it.
 
@@ -888,7 +898,7 @@ def analyze_callable(
         return [Finding("J100", f"trace failed: {e!r}", entrypoint=entrypoint)]
     findings = analyze_closed_jaxpr(
         closed, entrypoint, in_specs=in_specs, mesh_axes=mesh_axes,
-        hbm_budget_bytes=hbm_budget_bytes)
+        hbm_budget_bytes=hbm_budget_bytes, plan=plan)
     if expects_donation and hasattr(fn, "lower"):
         try:
             text = fn.lower(*args).as_text()

@@ -183,6 +183,10 @@ def build_parser(
     p.add_argument("--master_addr", type=str, default=None)
     p.add_argument("--master_port", type=str, default=None)
     p.add_argument("--mode", type=str, default=None, help="alias of --division (task4 parity)")
+    p.add_argument("--plan", type=str, default=None, metavar="PLAN_JSON",
+                   help="apply a planner-emitted plan.json (python -m "
+                        "tpudml.plan): its engine_config fills every knob "
+                        "left at its default (explicit flags win)")
     for name in extra:
         p.add_argument(name)
     return p
@@ -213,6 +217,17 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         cfg.data.division = {"division": "partition", "sampling": "sampling"}.get(
             args.mode, args.mode
         )
+    # Planner output (python -m tpudml.plan). Same precedence contract as
+    # the env knobs below: the plan's engine_config fills only the knobs
+    # the user left at their defaults, so explicit flags always win.
+    if getattr(args, "plan", None):
+        from tpudml.plan.emit import load_plan
+
+        ec = load_plan(args.plan)["engine_config"]
+        defaults = TrainConfig()
+        for name in ("zero1", "accum_steps", "sentinel", "obs", "aggregation"):
+            if name in ec and getattr(cfg, name) == getattr(defaults, name):
+                setattr(cfg, name, ec[name])
     # Fault-injection knobs exported by the launcher (tpudml.launch) ride the
     # environment so the task command line stays rank-agnostic. Precedence is
     # CLI > env: env fills only fields the user left at their defaults.

@@ -43,6 +43,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from tpudml.capabilities import reject
 from tpudml.optim.optimizers import ClipByGlobalNorm, Optimizer, shard_aware_clip
 
 PyTree = Any
@@ -116,11 +117,7 @@ class ZeRO1(Optimizer):
             )
         if _chain_has_clip(self.base):
             if self.stacked is not None:
-                raise ValueError(
-                    "ZeRO1(stacked=...) cannot wrap a ClipByGlobalNorm chain: "
-                    "stage-stacked chunks shard over two mesh axes and the "
-                    "clip's single-psum norm would double-count or miss shards"
-                )
+                reject("zero1_stacked_clip")
             object.__setattr__(
                 self,
                 "base",

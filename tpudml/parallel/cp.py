@@ -42,6 +42,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from tpudml.capabilities import reject
 from tpudml.comm.collectives import all_to_all, axis_size, pmean_tree, ppermute_ring
 from tpudml.nn.attention import NEG_INF
 from tpudml.nn.layers import Module
@@ -402,7 +403,7 @@ class ContextParallel:
         if layout not in ("contiguous", "striped"):
             raise ValueError(f"unknown layout {layout!r}")
         if save_scores and not fused_xent:
-            raise ValueError("save_scores requires fused_xent=True")
+            reject("save_scores_needs_fused_xent")
         model_layout = getattr(model, "seq_layout", "contiguous")
         if model_layout != layout:
             raise ValueError(

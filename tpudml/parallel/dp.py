@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tpudml.capabilities import reject
 from tpudml.comm.collectives import broadcast_from, get_aggregator, pmean_tree
 from tpudml.nn.losses import softmax_cross_entropy
 from tpudml.comm.timing import CommStats
@@ -103,7 +104,7 @@ class DataParallel:
         obs: bool | Tracer = False,
     ):
         if save_scores and not fused_xent:
-            raise ValueError("save_scores requires fused_xent=True")
+            reject("save_scores_needs_fused_xent")
         if fused_xent and (
             measure_comm or loss is not softmax_cross_entropy
         ):
@@ -113,38 +114,21 @@ class DataParallel:
             # rather than silently ignoring the arguments. (Gradient
             # accumulation composes: accumulate_fused_grads runs the
             # fused loss through the same micro-batch scan.)
-            raise ValueError(
-                "fused_xent composes with the fused step and the "
-                "built-in cross-entropy only (measure_comm=False, "
-                "default loss)"
-            )
+            reject("dp_fused_xent_split_step")
         if zero1_overlap and not zero1:
-            raise ValueError("zero1_overlap requires zero1=True")
+            reject("zero1_overlap_needs_zero1")
         if zero1 and aggregation != "allreduce":
             # ZeRO-1 REPLACES gradient aggregation: the reduce-scatter
             # inside the sharded update is the aggregation. Accepting an
             # alternative strategy here would silently not use it.
-            raise ValueError(
-                "zero1=True replaces gradient aggregation with its own "
-                "reduce-scatter; leave aggregation='allreduce' (the default)"
-            )
+            reject("zero1_replaces_aggregation")
         if zero1_overlap and accum_steps < 2:
-            raise ValueError(
-                "zero1_overlap needs accum_steps >= 2: the overlap hides "
-                "the param all_gather behind the micro-batch scan"
-            )
+            reject("zero1_overlap_needs_accum")
         if zero1_overlap and measure_comm:
-            raise ValueError(
-                "measure_comm is unsupported with zero1_overlap (the "
-                "split bracketing assumes the gather-at-end step layout); "
-                "use overlap_report() for exposed/hidden attribution"
-            )
+            reject("zero1_overlap_measure_comm")
         if isinstance(optimizer, ZeRO1):
             if not zero1:
-                raise ValueError(
-                    "a ZeRO1-wrapped optimizer needs zero1=True (the "
-                    "engine must shard the optimizer state it creates)"
-                )
+                reject("zero1_optimizer_needs_zero1")
             if optimizer.axis_name != axis_name or (
                 optimizer.world != mesh.shape[axis_name]
             ):

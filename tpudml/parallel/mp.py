@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tpudml.capabilities import reject
 from tpudml.nn.layers import Module
 from tpudml.nn.losses import softmax_cross_entropy
 from tpudml.obs.tracer import NULL_SPAN, Tracer
@@ -184,12 +185,9 @@ class GSPMDParallel:
         obs: bool | Tracer = False,
     ):
         if save_scores and not fused_xent:
-            raise ValueError("save_scores requires fused_xent=True")
+            reject("save_scores_needs_fused_xent")
         if fused_xent and (accum_steps != 1 or loss is not softmax_cross_entropy):
-            raise ValueError(
-                "fused_xent composes with the fused LM step and the built-in "
-                "cross-entropy only (no accum_steps, no custom loss)"
-            )
+            reject("gspmd_fused_xent_accum")
         self.model = model
         self.optimizer = optimizer
         # In-graph step sentinel (tpudml.resilience): under jit/GSPMD the

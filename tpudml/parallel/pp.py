@@ -37,6 +37,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tpudml.capabilities import reject
 from tpudml.comm.collectives import pmean_tree, ppermute_ring, psum_tree
 from tpudml.nn.layers import Module
 from tpudml.nn.losses import accuracy, softmax_cross_entropy
@@ -174,10 +175,7 @@ class GPipe:
             # PP×DP with ZeRO-1 weight-update sharding: the optimizer
             # state chunks over the DATA axis on top of the stage layout.
             if batch_axis is None:
-                raise ValueError(
-                    "a ZeRO1 optimizer needs a data axis to shard the "
-                    "update over: pass batch_axis (PP×DP composition)"
-                )
+                reject("pp_zero1_needs_batch_axis")
             z = self.optimizer
             if z.axis_name != batch_axis or z.world != mesh.shape[batch_axis]:
                 raise ValueError(
@@ -270,10 +268,7 @@ class GPipe:
             # train/rng threading through the scan); silent no-op dropout
             # would fake regularization, so reject it loudly. The 1F1B
             # engine threads per-(stage, micro) rng keys and lifts this.
-            raise ValueError(
-                "GPipe stages do not support dropout; use OneFOneB "
-                "(schedule='1f1b') with rng_root for dropout pipelines"
-            )
+            reject("gpipe_dropout")
 
     def init_params(self, key: jax.Array) -> PyTree:
         kp, kb, ke = jax.random.split(key, 3)

@@ -1,0 +1,368 @@
+"""Plan emission: rank the survivors, build + trace + verify the
+winner, serialize the runnable ``plan.json`` (v1 schema).
+
+``make_plan`` is the whole pipeline.  Self-verification is the
+load-bearing part: the winning candidate is constructed as a *real*
+engine on the dryrun mesh (the same classes the tasks instantiate),
+its jitted step traced, and the PR 10 dataflow rules (J112–J116) run
+over the jaxpr — a plan that would lose a psum, reuse a donated
+buffer, or blow the HBM budget is demoted before it is ever emitted,
+and the next-ranked survivor is tried.  The verification trace also
+stamps the plan's ``predicted`` block (ring-model wire bytes +
+peak-live HBM of the winner), which is the contract rule J118 later
+holds the code to: re-trace the entrypoint, compare against
+``predicted``, flag >10% drift.
+
+plan.json v1 schema (all byte-deterministic — no timestamps, sorted
+keys)::
+
+    {
+      "version": 1,
+      "world": int,
+      "spec": ModelSpec.to_dict(),
+      "hbm_budget_bytes": int | null,
+      "winner": {"candidate": {...}, "score": {...}},
+      "engine_config": {... flat knobs train/task wiring consumes ...},
+      "ranking": [{"candidate", "score"}, ...],          # survivors, best first
+      "pruned": [{"candidate", "rule", "reason"}, ...],  # every drop, with why
+      "predicted": {"comm_wire_bytes": float, "peak_hbm_bytes": int},
+      "verification": {"entrypoint", "ok", "findings": [...],
+                       "demoted": [...]}                 # winners that failed
+    }
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+from tpudml.plan.prune import prune
+from tpudml.plan.score import PP_MICROBATCHES, score_candidate
+from tpudml.plan.space import Candidate, ModelSpec, enumerate_candidates
+
+PLAN_VERSION = 1
+
+
+def _mesh(axes: dict, world: int):
+    import jax
+    from tpudml.core.config import MeshConfig
+    from tpudml.core.dist import make_mesh
+
+    if len(jax.devices()) < world:
+        raise RuntimeError(
+            f"plan verification needs {world} devices, have "
+            f"{len(jax.devices())} — set "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count=8"
+        )
+    return make_mesh(MeshConfig(axes), jax.devices()[:world])
+
+
+def _model(spec: ModelSpec):
+    from tpudml.models import TransformerLM
+
+    return TransformerLM(
+        vocab_size=spec.vocab_size,
+        embed_dim=spec.embed_dim,
+        num_heads=spec.num_heads,
+        num_layers=spec.num_layers,
+        max_len=spec.seq_len,
+        impl="full",
+        rope=True,
+    )
+
+
+def _batch(spec: ModelSpec, world: int):
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    rows = spec.global_batch(world)
+    seqs = rng.integers(
+        0, spec.vocab_size, size=(rows, spec.seq_len + 1)
+    ).astype(np.int32)
+    return seqs[:, :-1], seqs[:, 1:]
+
+
+def build_candidate(spec: ModelSpec, cand: Candidate):
+    """Instantiate the candidate as a real engine on the dryrun mesh.
+
+    Returns ``(engine, train_state, step, (x, y))`` — ``step`` is the
+    engine's train step whose ``.jitted`` is the traceable program.
+    The construction mirrors the task CLIs; if the candidate violated a
+    composition rule the constructor would raise here, which is exactly
+    the planner/runtime agreement the capability table guarantees never
+    happens for a pruned-in candidate.
+    """
+    from tpudml.core.prng import seed_key
+    from tpudml.optim import make_optimizer
+
+    world = 1
+    for _, s in cand.mesh:
+        world *= s
+    mesh_axes = cand.mesh_dict
+    model = _model(spec)
+    opt = make_optimizer("adamw", 3e-4)
+    common = dict(
+        fused_xent=cand.fused_xent,
+        sentinel=cand.sentinel,
+        obs=cand.obs,
+    )
+    if cand.engine in ("dp", "zero1"):
+        from tpudml.parallel.dp import DataParallel
+
+        eng = DataParallel(
+            model, opt, _mesh(mesh_axes, world),
+            stacked_batches=False,
+            accum_steps=cand.accum_steps,
+            zero1=cand.zero1,
+            zero1_overlap=cand.zero1_overlap,
+            **common,
+        )
+    elif cand.engine in ("fsdp", "fsdp_tp"):
+        from tpudml.parallel.fsdp import FSDP
+        from tpudml.parallel.mp import tensor_parallel_rules
+
+        eng = FSDP(
+            model, opt, _mesh(mesh_axes, world),
+            base_rule=(
+                tensor_parallel_rules("model")
+                if cand.engine == "fsdp_tp" else None
+            ),
+            accum_steps=cand.accum_steps,
+            **common,
+        )
+    elif cand.engine == "tp":
+        from tpudml.parallel.mp import GSPMDParallel, tensor_parallel_rules
+
+        eng = GSPMDParallel(
+            model, opt, _mesh(mesh_axes, world),
+            rule=tensor_parallel_rules("model"),
+            axis_name="model",
+            accum_steps=cand.accum_steps,
+            **common,
+        )
+    elif cand.engine == "pp_dp":
+        from tpudml.models import (
+            TransformerBlock,
+            TransformerEmbed,
+            TransformerHead,
+        )
+        from tpudml.nn.layers import Sequential
+        from tpudml.parallel.pp import GPipe
+
+        stages = mesh_axes["stage"]
+        per_stage = spec.num_layers // stages
+        block = TransformerBlock(
+            spec.embed_dim, spec.num_heads, causal=True, impl="full",
+            rope=True,
+        )
+        if per_stage > 1:
+            block = Sequential(tuple(
+                dataclasses.replace(block) for _ in range(per_stage)
+            ))
+        eng = GPipe(
+            block,
+            n_microbatches=PP_MICROBATCHES,
+            mesh=_mesh(mesh_axes, world),
+            optimizer=opt,
+            prologue=TransformerEmbed(
+                spec.vocab_size, spec.embed_dim, spec.seq_len,
+                use_pos_embed=False,  # blocks carry RoPE
+            ),
+            epilogue=TransformerHead(spec.embed_dim, spec.vocab_size),
+            batch_axis="data",
+            sentinel=cand.sentinel,
+            obs=cand.obs,
+        )
+    else:
+        raise ValueError(f"unknown engine {cand.engine!r}")
+    ts = eng.create_state(seed_key(0))
+    step = eng.make_train_step()
+    x, y = _batch(spec, world)
+    return eng, ts, step, (x, y)
+
+
+def verify_candidate(
+    spec: ModelSpec,
+    cand: Candidate,
+    hbm_budget_bytes: int | None = None,
+) -> dict:
+    """Build, trace, and run the dataflow rules over the candidate.
+
+    Returns the plan's ``verification`` record plus the traced
+    ``predicted`` costs.  ``ok`` is False when any error-severity
+    finding (J112–J116 family) fires — the caller demotes the
+    candidate and tries the next survivor.
+    """
+    import jax
+
+    from tpudml.analysis.cost import peak_live_bytes
+    from tpudml.analysis.dataflow import analyze_dataflow
+    from tpudml.analysis.findings import RULES
+    from tpudml.analysis.jaxpr_pass import analyze_closed_jaxpr
+
+    _, ts, step, (x, y) = build_candidate(spec, cand)
+    fn = getattr(step, "jitted", step)
+    entrypoint = f"plan:{cand.key()}"
+    in_specs = getattr(step, "in_specs", None)
+    mesh_axes = getattr(step, "mesh_axes", None)
+    closed = jax.make_jaxpr(fn)(ts, x, y)
+    findings = analyze_closed_jaxpr(
+        closed,
+        entrypoint=entrypoint,
+        in_specs=in_specs,
+        mesh_axes=mesh_axes,
+        hbm_budget_bytes=hbm_budget_bytes,
+    )
+    flow = analyze_dataflow(
+        closed, entrypoint, in_specs=in_specs, mesh_axes=mesh_axes
+    )
+    traced_comm = float(
+        sum(ev.wire_bytes * ev.trips for ev in flow.comm_events)
+    )
+    peak = int(peak_live_bytes(closed))
+    # J116 (over HBM budget) is warn-severity for the reporting CLI but
+    # a hard plan rejection here: an over-budget winner never ships.
+    errors = [
+        f for f in findings
+        if RULES[f.rule][0] == "error" or f.rule == "J116"
+    ]
+    return {
+        "entrypoint": entrypoint,
+        "ok": not errors,
+        "findings": [dataclasses.asdict(f) for f in findings],
+        "predicted": {
+            "comm_wire_bytes": traced_comm,
+            "peak_hbm_bytes": peak,
+        },
+    }
+
+
+def plan_drift_findings(plan: dict) -> list:
+    """Re-trace the plan's winning entrypoint with rule J118 armed.
+
+    The contract check ``python -m tpudml.analysis --plan`` runs: build
+    the winner the plan describes, trace it, and compare the traced
+    collective wire bytes + peak-live HBM against the plan's
+    ``predicted`` block (10% tolerance, the obs drift threshold).  A
+    fresh plan is green by construction — ``predicted`` was stamped from
+    this same trace; code drift after emission is what fires.
+    """
+    import jax
+
+    from tpudml.analysis.jaxpr_pass import analyze_closed_jaxpr
+    from tpudml.plan.space import Candidate
+
+    spec = ModelSpec.from_dict(plan["spec"])
+    cand = Candidate.from_dict(plan["winner"]["candidate"])
+    _, ts, step, (x, y) = build_candidate(spec, cand)
+    fn = getattr(step, "jitted", step)
+    closed = jax.make_jaxpr(fn)(ts, x, y)
+    return analyze_closed_jaxpr(
+        closed,
+        entrypoint=f"plan:{cand.key()}",
+        in_specs=getattr(step, "in_specs", None),
+        mesh_axes=getattr(step, "mesh_axes", None),
+        hbm_budget_bytes=plan.get("hbm_budget_bytes"),
+        plan=plan,
+    )
+
+
+def make_plan(
+    spec: ModelSpec,
+    world: int,
+    hbm_budget_bytes: int | None = None,
+    engines=None,
+    verify: bool = True,
+) -> dict:
+    """enumerate → prune → score → verify-the-winner → plan dict."""
+    cands = enumerate_candidates(world, engines=engines)
+    survivors, dropped = prune(spec, cands, hbm_budget_bytes)
+    if not survivors:
+        raise RuntimeError(
+            f"no feasible candidate at world {world}: all "
+            f"{len(cands)} pruned"
+        )
+    scored = [(score_candidate(spec, c), c) for c in survivors]
+    scored.sort(key=lambda sc: (sc[0].per_token_s, sc[1].key()))
+
+    demoted = []
+    verification = {"entrypoint": None, "ok": True, "findings": []}
+    predicted = None
+    winner_idx = 0
+    if verify:
+        for i, (_, cand) in enumerate(scored):
+            v = verify_candidate(spec, cand, hbm_budget_bytes)
+            if v["ok"]:
+                winner_idx = i
+                predicted = v.pop("predicted")
+                verification = v
+                break
+            demoted.append({
+                "candidate": cand.to_dict(),
+                "findings": v["findings"],
+            })
+        else:
+            raise RuntimeError(
+                f"every scored candidate at world {world} failed "
+                f"dataflow verification ({len(demoted)} demoted)"
+            )
+    score, winner = scored[winner_idx]
+    if predicted is None:
+        # verify=False: fall back to the analytic estimates so the
+        # schema stays total (J118 will then hold code to the model).
+        predicted = {
+            "comm_wire_bytes": score.comm_wire_bytes,
+            "peak_hbm_bytes": score.est_hbm_bytes,
+        }
+    verification["demoted"] = demoted
+    return {
+        "version": PLAN_VERSION,
+        "world": world,
+        "spec": spec.to_dict(),
+        "hbm_budget_bytes": hbm_budget_bytes,
+        "winner": {
+            "candidate": winner.to_dict(),
+            "score": score.to_dict(),
+        },
+        "engine_config": engine_config(winner),
+        "ranking": [
+            {"candidate": c.to_dict(), "score": s.to_dict()}
+            for s, c in scored
+        ],
+        "pruned": [r.to_dict() for r in dropped],
+        "predicted": predicted,
+        "verification": verification,
+    }
+
+
+def engine_config(cand: Candidate) -> dict:
+    """The flat runnable knob record ``--plan plan.json`` wiring
+    consumes (core/config.py merges it into TrainConfig)."""
+    return {
+        "engine": cand.engine,
+        "mesh": cand.mesh_dict,
+        "zero1": cand.zero1,
+        "zero1_overlap": cand.zero1_overlap,
+        "accum_steps": cand.accum_steps,
+        "fused_xent": cand.fused_xent,
+        "sentinel": cand.sentinel,
+        "obs": cand.obs,
+        "aggregation": "allreduce",
+    }
+
+
+def plan_to_json(plan: dict) -> str:
+    """Byte-deterministic serialization — the determinism test pins
+    two same-input emissions to identical bytes."""
+    return json.dumps(plan, indent=2, sort_keys=True) + "\n"
+
+
+def load_plan(path: str) -> dict:
+    with open(path) as fh:
+        plan = json.load(fh)
+    ver = plan.get("version")
+    if ver != PLAN_VERSION:
+        raise ValueError(
+            f"{path}: plan version {ver!r} != supported {PLAN_VERSION}"
+        )
+    return plan

@@ -49,6 +49,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tpudml.capabilities import CompositionError, reject
 from tpudml.serve.cache import KINDS
 from tpudml.serve.load import Request
 from tpudml.serve.paged import PAGED_DECODE_MARKER, PagePool
@@ -56,7 +57,7 @@ from tpudml.serve.sched import DecodeCostModel, SLOConfig
 from tpudml.serve.spec import draft_from_trunk, make_spec_decode_step
 
 
-class ServeCompositionError(ValueError):
+class ServeCompositionError(CompositionError):
     """Raised when serving levers are combined in a regime this tier has
     no correct compiled path for (today: tensor parallelism × paged
     cache, and tensor parallelism × speculative decoding). Loud by
@@ -402,11 +403,7 @@ class ServingEngine:
             # body that knows nothing of page tables or verify windows.
             # Until those bodies exist, composing would silently run the
             # unsharded math on sharded params — reject instead.
-            raise ServeCompositionError(
-                "tensor-parallel serving does not compose with "
-                "cache_layout='paged' or spec_k>0 yet; run TP dense, or "
-                "paged/spec single-device"
-            )
+            reject("serve_tp_paged_spec", exc=ServeCompositionError)
         self._tp = None
         if mesh is not None:
             from tpudml.serve.tp import TPServing

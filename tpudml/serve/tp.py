@@ -46,12 +46,10 @@ class TPServing:
             # shard_map decode body has no page-table or verify-window
             # variant, and running the dense body against a paged/spec
             # engine state would be a silent wrong-answer path.
+            from tpudml.capabilities import reject
             from tpudml.serve.engine import ServeCompositionError
 
-            raise ServeCompositionError(
-                "TPServing supports cache_layout='dense' with spec_k=0 "
-                "only; paged/speculative serving is single-device"
-            )
+            reject("serve_tp_dense_only", exc=ServeCompositionError)
         self.model = model
         self.mesh = mesh
         self.axis = axis_name
