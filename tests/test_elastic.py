@@ -1,0 +1,135 @@
+"""tpudml.elastic: membership-aware restart + the scripted failure drill.
+
+Controller semantics (policy, fresh rendezvous port, budget, min_world)
+are pinned with jax-free subprocess children, so they run in seconds; the
+full drill — real gloo collectives, SIGKILL-grade rank death, bit-exact
+resume — is the e2e capstone and carries the multi-OS-process cost.
+"""
+
+import io
+import sys
+
+import pytest
+
+from tpudml.elastic.controller import ROUND_ENV, ElasticController
+from tpudml.launch.cluster import ClusterSpec
+
+PY = sys.executable
+
+# A child whose behaviour is scripted per (rank, round) via the
+# controller's env contract — no jax import, so each round costs ~0.1s.
+_SCRIPTED = """
+import os, sys, time
+rank = int(os.environ["TPUDML_PROCESS_ID"])
+rnd = int(os.environ[{round_env!r}])
+{body}
+"""
+
+
+def _child(body: str) -> list[str]:
+    return [PY, "-c", _SCRIPTED.format(round_env=ROUND_ENV, body=body)]
+
+
+def test_reform_fresh_port_and_no_zombie_deadlock():
+    """Rank 1 dies in round 0 while rank 0 would block for 300s (the
+    zombie): containment must kill rank 0 promptly, and the re-form must
+    rendezvous on a port never used by round 0 — within a wall-clock
+    budget nowhere near the zombie's sleep."""
+    cmd = _child(
+        "if rnd == 0:\n"
+        "    if rank == 1:\n"
+        "        sys.exit(3)\n"
+        "    time.sleep(300)\n"
+        "sys.exit(0)\n"
+    )
+    spec = ClusterSpec(num_processes=2, timeout_s=60.0, grace_s=1.0)
+    res = ElasticController(cmd, spec, max_reforms=2, sink=io.StringIO()).run()
+    assert res.success and res.stop_reason == "success"
+    assert res.reforms == 1 and len(res.records) == 2
+    assert res.records[0].failed_rank == 1
+    assert res.records[0].returncodes[1] == 3
+    assert res.records[1].coordinator_port != res.records[0].coordinator_port
+    assert res.records[1].world == 2  # restart policy refills the slot
+    assert res.total_elapsed_s < 30.0  # nobody waited for the zombie
+
+
+def test_shrink_policy_reforms_at_world_minus_one():
+    cmd = _child(
+        "if rnd == 0 and rank == 2:\n"
+        "    sys.exit(4)\n"
+        "sys.exit(0)\n"
+    )
+    spec = ClusterSpec(num_processes=3, timeout_s=60.0, grace_s=1.0)
+    res = ElasticController(
+        cmd, spec, policy="shrink", max_reforms=2, sink=io.StringIO()
+    ).run()
+    assert res.success
+    assert [r.world for r in res.records] == [3, 2]
+    assert res.final_world == 2
+
+
+def test_shrink_respects_min_world():
+    cmd = _child("sys.exit(7)\n")
+    spec = ClusterSpec(num_processes=2, timeout_s=60.0, grace_s=1.0)
+    res = ElasticController(
+        cmd, spec, policy="shrink", min_world=2, max_reforms=3,
+        sink=io.StringIO(),
+    ).run()
+    assert not res.success
+    assert res.stop_reason == "below_min_world"
+    assert len(res.records) == 1  # no re-form below the quorum
+
+
+def test_budget_is_charged_across_rounds_and_backoff():
+    """A backoff that would overrun the whole-job budget must stop the
+    controller instead of sleeping through it."""
+    cmd = _child("sys.exit(5)\n")
+    spec = ClusterSpec(
+        num_processes=2,
+        timeout_s=2.0,
+        grace_s=0.5,
+        restart_backoff_s=30.0,
+    )
+    res = ElasticController(cmd, spec, max_reforms=3, sink=io.StringIO()).run()
+    assert not res.success
+    assert res.stop_reason == "budget_exhausted"
+    assert len(res.records) == 1
+    assert res.total_elapsed_s < 5.0  # it did NOT take the 30s backoff
+
+
+def test_max_reforms_bounds_rounds():
+    cmd = _child("sys.exit(9)\n")
+    spec = ClusterSpec(num_processes=2, timeout_s=60.0, grace_s=0.5)
+    res = ElasticController(cmd, spec, max_reforms=2, sink=io.StringIO()).run()
+    assert not res.success
+    assert res.stop_reason == "max_reforms"
+    assert len(res.records) == 3
+    ports = [r.coordinator_port for r in res.records]
+    assert len(set(ports)) == len(ports)  # every round rendezvoused fresh
+
+
+def test_bad_policy_rejected():
+    with pytest.raises(ValueError):
+        ElasticController([PY, "-c", "pass"], policy="resurrect")
+
+
+def test_drill_kill_reform_resume_bit_exact(tmp_path):
+    """The tentpole e2e: 2-process gloo training, rank 1 hard-killed at
+    step 13 → controller re-forms on a fresh port after seeded backoff →
+    resume from the newest CRC-valid sharded checkpoint → final params
+    bit-identical to an uninterrupted run, with one trace pid per rank."""
+    from tpudml.elastic.drill import run_drill
+
+    report = run_drill(str(tmp_path), timeout_s=300.0)
+    assert report["ok"], report
+    assert report["bit_exact"]
+    assert report["reforms"] == 1
+    assert report["killed_rank_observed"] == 1
+    assert report["resume_step"] == 10  # newest checkpoint before step 13
+    assert report["steps_lost"] == 3
+    assert report["fresh_port"]
+    assert report["backoff_s"] > 0
+    assert report["restart_latency_s"] > report["backoff_s"]
+    assert report["trace_pids"] == [0, 1]
+    merged = tmp_path / "obs" / "trace.json"
+    assert merged.exists()

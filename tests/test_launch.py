@@ -394,3 +394,21 @@ def test_rank_kill_containment_and_backoff_recovery(tmp_path):
     assert result.backoffs_s == [0.01]
     assert "restart 1/1" in sink.getvalue()
     assert (tmp_path / "killed-once").exists()
+
+
+def test_launch_check_cli():
+    """``python -m tpudml.launch --check``: the CI smoke proving the
+    multi-process CPU wiring (gloo collectives + rendezvous) end to end
+    from the CLI — exit 0 and one correct-psum line per rank."""
+    import subprocess
+
+    proc = subprocess.run(
+        [PY, "-m", "tpudml.launch", "--check", "--timeout_s", "180"],
+        capture_output=True,
+        text=True,
+        timeout=240,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "[rank 0] [check] rank 0/2 psum 1.0 OK" in proc.stdout
+    assert "[rank 1] [check] rank 1/2 psum 1.0 OK" in proc.stdout
+    assert "launch --check: OK" in proc.stdout

@@ -115,6 +115,27 @@ def test_validate_rejects_malformed():
         validate_chrome_trace(doc)
 
 
+def test_merge_chrome_traces_one_pid_track_per_process():
+    """Multi-process evidence path (tpudml.elastic drill): per-rank
+    exports merge into one document with one pid track per process,
+    deterministically ordered, and a pid collision is a loud error."""
+    from tpudml.obs import merge_chrome_traces
+
+    docs = [golden_tracer().chrome_trace(pid=p) for p in (1, 0)]
+    merged = merge_chrome_traces(docs)
+    validate_chrome_trace(merged)
+    metas = [e for e in merged["traceEvents"] if e["ph"] == "M"]
+    events = [e for e in merged["traceEvents"] if e["ph"] != "M"]
+    assert [m["pid"] for m in metas] == [0, 1]
+    assert {e["pid"] for e in events} == {0, 1}
+    keys = [(e["pid"], e["ts"], -e.get("dur", 0)) for e in events]
+    assert keys == sorted(keys)
+    # Byte-deterministic regardless of input order.
+    assert dump_trace(merged) == dump_trace(merge_chrome_traces(docs[::-1]))
+    with pytest.raises(ValueError, match="duplicate pid"):
+        merge_chrome_traces([docs[0], docs[0]])
+
+
 def test_tracer_summary_percentiles():
     s = golden_tracer().summary()
     assert s["schema"] == TRACE_SCHEMA_VERSION

@@ -85,7 +85,7 @@ def save_sharded_checkpoint(
             meta[key] = {
                 "leaf": i,
                 "index": _norm_index(
-                    tuple(slice(None)) * np.ndim(leaf), np.shape(leaf)
+                    (slice(None),) * np.ndim(leaf), np.shape(leaf)
                 ),
                 "desc": desc,
                 "crc": _crc(arr),

@@ -35,6 +35,14 @@ class DistributedConfig:
     process_id: int = 0
     backend: str | None = None  # None = autodetect platform
     initialize_timeout_s: int = 300
+    # Cross-process collective implementation for the CPU backend. XLA's
+    # CPU client cannot run multi-process computations natively; jax
+    # 0.4.37 wires MPI or gloo underneath via
+    # ``jax_cpu_collectives_implementation``. None = auto: "gloo" whenever
+    # the job is multi-process AND the platform is CPU (JAX_PLATFORMS=cpu
+    # or backend="cpu"), nothing otherwise. "none" opts out explicitly.
+    # Env: TPUDML_CPU_COLLECTIVES.
+    cpu_collectives: str | None = None
     # True when the world size was given explicitly (--n_devices / env), so
     # single-host runs can distinguish "--n_devices 1" (use ONE device — the
     # single-machine baseline of sections/task3.tex:23) from the default
@@ -63,6 +71,7 @@ class DistributedConfig:
             num_processes=int(nproc) if nproc is not None else 1,
             process_id=int(os.environ.get("TPUDML_PROCESS_ID", os.environ.get("RANK", "0"))),
             backend=os.environ.get("TPUDML_BACKEND"),
+            cpu_collectives=os.environ.get("TPUDML_CPU_COLLECTIVES"),
             explicit_world=nproc is not None,
         )
 

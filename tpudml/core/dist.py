@@ -10,6 +10,7 @@ host-level step is ``jax.distributed.initialize`` for multi-process runs.
 from __future__ import annotations
 
 import logging
+import os
 
 import jax
 import numpy as np
@@ -22,6 +23,29 @@ log = logging.getLogger("tpudml")
 _initialized = False
 
 
+def _platform_is_cpu(cfg: DistributedConfig) -> bool:
+    """Whether this job will run on the CPU backend — decided WITHOUT
+    touching ``jax.devices()`` (instantiating a backend here would latch
+    it before the collectives knob below can take effect)."""
+    if cfg.backend is not None:
+        return cfg.backend == "cpu"
+    return os.environ.get("JAX_PLATFORMS", "").split(",")[0] == "cpu"
+
+
+def resolve_cpu_collectives(cfg: DistributedConfig) -> str | None:
+    """The cross-process CPU collectives implementation this config asks
+    for: the explicit ``cpu_collectives`` value (env
+    ``TPUDML_CPU_COLLECTIVES``; ``"none"`` opts out), else ``"gloo"``
+    exactly when the job is multi-process on the CPU platform — the wiring
+    that makes ``JAX_PLATFORMS=cpu`` multi-process jobs actually compute
+    (XLA:CPU alone rejects them with "Multiprocess computations aren't
+    implemented on the CPU backend")."""
+    impl = cfg.cpu_collectives
+    if impl is None and cfg.num_processes > 1 and _platform_is_cpu(cfg):
+        impl = "gloo"
+    return None if impl in (None, "none", "") else impl
+
+
 def distributed_init(cfg: DistributedConfig | None = None) -> None:
     """Initialize the multi-process JAX runtime (idempotent).
 
@@ -30,12 +54,22 @@ def distributed_init(cfg: DistributedConfig | None = None) -> None:
     and afterwards ``process_index()``/``process_count()`` report the
     caller's rank/world. Single-process runs (coordinator_address=None) are
     a no-op, matching the reference's single-GPU task1 path.
+
+    On the CPU platform, multi-process init also selects a cross-process
+    collectives implementation (:func:`resolve_cpu_collectives`, default
+    gloo) BEFORE the backend instantiates — the reference's
+    ``init_process_group(backend="gloo")`` finally has a real analogue
+    here, and the 2-process CI jobs psum across process boundaries for
+    real instead of failing in the first collective.
     """
     global _initialized
     if _initialized:
         return
     cfg = cfg or DistributedConfig.from_env()
     if cfg.coordinator_address is not None and cfg.num_processes > 1:
+        impl = resolve_cpu_collectives(cfg)
+        if impl is not None:
+            jax.config.update("jax_cpu_collectives_implementation", impl)
         jax.distributed.initialize(
             coordinator_address=cfg.coordinator_address,
             num_processes=cfg.num_processes,

@@ -1,0 +1,92 @@
+"""CLI: ``python -m tpudml.elastic`` — elastic supervision + the drill.
+
+Drill mode (the acceptance gate — exits 0 iff the kill→re-form→resume
+sequence reproduced the uninterrupted run bit-exactly)::
+
+    JAX_PLATFORMS=cpu python -m tpudml.elastic --drill
+
+Supervision mode (the elastic counterpart of ``python -m tpudml.launch``:
+re-forms on failure instead of plain relaunch)::
+
+    python -m tpudml.elastic -n 4 --policy shrink --min_world 2 \
+        --max_reforms 3 --backoff_s 1.0 -- \
+        python -m tasks.task2 --ckpt_dir ckpts --resume
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import tempfile
+
+from tpudml.elastic.controller import ElasticController
+from tpudml.elastic.drill import run_drill
+from tpudml.launch.cluster import ClusterSpec
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--" in argv:
+        split = argv.index("--")
+        argv, cmd = argv[:split], argv[split + 1 :]
+    else:
+        cmd = []
+    p = argparse.ArgumentParser(prog="tpudml.elastic")
+    p.add_argument("--drill", action="store_true",
+                   help="run the scripted failure drill; exit 0 iff the "
+                        "resumed run is bit-identical to an uninterrupted one")
+    p.add_argument("--dir", type=str, default=None,
+                   help="drill working dir (default: a fresh temp dir)")
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--ckpt_every", type=int, default=5)
+    p.add_argument("--kill_step", type=int, default=13)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("-n", "--num_processes", type=int, default=2)
+    p.add_argument("--policy", choices=("restart", "shrink"), default="restart")
+    p.add_argument("--min_world", type=int, default=1)
+    p.add_argument("--max_reforms", type=int, default=2)
+    p.add_argument("--timeout_s", type=float, default=None)
+    p.add_argument("--backoff_s", type=float, default=0.0)
+    args = p.parse_args(argv)
+
+    if args.drill:
+        base = args.dir or tempfile.mkdtemp(prefix="tpudml_drill_")
+        report = run_drill(
+            base,
+            world=args.num_processes,
+            steps=args.steps,
+            ckpt_every=args.ckpt_every,
+            kill_step=args.kill_step,
+            seed=args.seed,
+            backoff_s=args.backoff_s or 0.25,
+        )
+        print(json.dumps(report, sort_keys=True))
+        return 0 if report["ok"] else 1
+
+    if not cmd:
+        p.error("no command given; usage: python -m tpudml.elastic [opts] -- cmd ...")
+    spec = ClusterSpec(
+        num_processes=args.num_processes,
+        timeout_s=args.timeout_s,
+        restart_backoff_s=args.backoff_s,
+        restart_backoff_seed=args.seed,
+    )
+    ctrl = ElasticController(
+        cmd,
+        spec,
+        policy=args.policy,
+        min_world=args.min_world,
+        max_reforms=args.max_reforms,
+    )
+    res = ctrl.run()
+    print(
+        f"[elastic] {res.stop_reason}: {len(res.records)} round(s), "
+        f"final world {res.final_world}, {res.total_elapsed_s:.1f}s",
+        file=sys.stderr,
+    )
+    return 0 if res.success else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,399 @@
+"""The elastic failure drill: kill → backoff → re-form → bit-exact resume.
+
+Two halves:
+
+- :func:`child_main` — the per-rank training program the drill supervises
+  (``python -m tpudml.elastic.drill``). A deliberately small but *real*
+  multi-process job: gloo-backed cross-process psum DP on a
+  ``('data',)`` mesh, batches that are a pure function of the step index
+  (so any incarnation replays the same trajectory), sharded CRC-verified
+  checkpoints every k steps, and resume from the newest valid step. A
+  seeded :func:`~tpudml.resilience.faults.rank_kill_hook` plays the
+  adversary: ``os._exit`` mid-training, at most once per drill (marker
+  file). Each rank prints its final parameter CRC and exports its own
+  flight-recorder track (one Chrome-trace pid per process).
+
+- :func:`run_drill` — the drill driver and the MTTR evidence source: run
+  the job once uninterrupted, once under :class:`ElasticController` with
+  the adversary armed, then require the two final parameter CRCs to be
+  **bit-identical** and report recovery stats (steps lost to the kill,
+  restart latency including backoff, wall-clock overhead vs the
+  uninterrupted run).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import io
+import json
+import re
+import sys
+import time
+import zlib
+from pathlib import Path
+
+import numpy as np
+
+
+# --------------------------------------------------------------- child
+
+
+def _params_crc(tree) -> int:
+    """CRC-32 over the concatenated little-endian bytes of every leaf, in
+    ``jax.tree.leaves`` order — the drill's bit-exactness witness."""
+    import jax
+
+    crc = 0
+    for leaf in jax.tree.leaves(tree):
+        crc = zlib.crc32(np.ascontiguousarray(np.asarray(leaf)).tobytes(), crc)
+    return crc
+
+
+def child_main(argv: list[str] | None = None) -> int:
+    """One rank of the drill job (rank/world/coordinator via the
+    launcher's TPUDML_* env contract)."""
+    ap = argparse.ArgumentParser(prog="tpudml.elastic.drill")
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--ckpt_dir", type=str, required=True)
+    ap.add_argument("--ckpt_every", type=int, default=5)
+    ap.add_argument("--global_batch", type=int, default=16)
+    ap.add_argument("--feature_dim", type=int, default=16)
+    ap.add_argument("--classes", type=int, default=4)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--momentum", type=float, default=0.9)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--kill_step", type=int, default=-1)
+    ap.add_argument("--kill_rank", type=int, default=1)
+    ap.add_argument("--kill_marker", type=str, default=None)
+    ap.add_argument("--obs_dir", type=str, default=None)
+    args = ap.parse_args(argv)
+
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpudml.checkpoint.sharded import (
+        restore_latest_valid_sharded,
+        save_sharded_checkpoint,
+    )
+    from tpudml.core.config import DistributedConfig, MeshConfig
+    from tpudml.core.dist import distributed_init, make_mesh, process_index
+    from tpudml.core.prng import seed_key
+    from tpudml.models.mlp import ForwardMLP
+    from tpudml.nn.losses import softmax_cross_entropy
+    from tpudml.obs.tracer import Tracer, set_tracer
+    from tpudml.optim.optimizers import make_optimizer
+    from tpudml.parallel.sharding import shard_map_fn
+    from tpudml.resilience.faults import rank_kill_hook
+
+    distributed_init(DistributedConfig.from_env())
+    rank = process_index()
+    tracer = Tracer()
+    set_tracer(tracer)
+    mesh = make_mesh(MeshConfig({"data": -1}))
+    world = int(np.prod(mesh.devices.shape))
+    if args.global_batch % world:
+        raise SystemExit(f"global_batch {args.global_batch} % world {world} != 0")
+
+    model = ForwardMLP(
+        in_features=args.feature_dim, hidden=(32, 16), num_classes=args.classes
+    )
+    params, _ = model.init(seed_key(args.seed))
+    opt = make_optimizer("sgd", args.lr, momentum=args.momentum)
+    opt_state = opt.init(params)
+
+    # Batches are a pure function of the step index (same on every rank and
+    # every incarnation): a resumed run replays steps c..N-1 bit-exactly.
+    teacher = (
+        np.random.default_rng(args.seed + 777)
+        .standard_normal((args.feature_dim, args.classes))
+        .astype(np.float32)
+    )
+
+    def batch_for(step: int) -> tuple[np.ndarray, np.ndarray]:
+        rng = np.random.default_rng(args.seed * 1_000_003 + step)
+        x = rng.standard_normal((args.global_batch, args.feature_dim)).astype(
+            np.float32
+        )
+        y = np.argmax(x @ teacher, axis=1).astype(np.int32)
+        return x, y
+
+    rep = NamedSharding(mesh, P())
+    row_sharded = NamedSharding(mesh, P("data"))
+
+    def to_global(host: np.ndarray, sharding) -> jax.Array:
+        return jax.make_array_from_callback(
+            host.shape, sharding, lambda idx, a=host: a[idx]
+        )
+
+    def replicate(tree):
+        return jax.tree.map(
+            lambda a: to_global(np.asarray(a), rep), tree
+        )
+
+    # Resume from the newest CRC-valid sharded checkpoint, if any. The
+    # restore reassembles full host arrays from ALL processes' shards, so
+    # this works even when the writing incarnation had a different world
+    # size (the controller's "shrink" policy).
+    target = {
+        "opt": jax.tree.map(np.asarray, opt_state),
+        "params": jax.tree.map(np.asarray, params),
+        "step": np.zeros((), np.int64),
+    }
+    restored = restore_latest_valid_sharded(args.ckpt_dir, target)
+    start_step = int(restored["step"])
+    if start_step:
+        print(
+            f"[drill] rank {rank} resumed step {start_step} "
+            f"wall {time.time():.3f}",
+            flush=True,
+        )
+        tracer.instant("drill_resume", cat="elastic", args={"step": start_step})
+    params = replicate(restored["params"])
+    opt_state = replicate(restored["opt"])
+
+    def step_body(params, opt_state, x, y):
+        def loss_fn(p):
+            logits, _ = model.apply(p, {}, x, train=True)
+            return softmax_cross_entropy(logits, y)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        grads = jax.tree.map(lambda g: jax.lax.pmean(g, "data"), grads)
+        loss = jax.lax.pmean(loss, "data")
+        new_params, new_opt = opt.update(grads, opt_state, params)
+        return new_params, new_opt, loss
+
+    step_fn = jax.jit(
+        shard_map_fn(
+            step_body,
+            mesh,
+            in_specs=(P(), P(), P("data"), P("data")),
+            out_specs=(P(), P(), P()),
+        )
+    )
+
+    kill = None
+    if args.kill_step >= 0:
+        kill = rank_kill_hook(
+            args.kill_step, marker=args.kill_marker, rank=args.kill_rank
+        )
+
+    loss = None
+    for step in range(start_step, args.steps):
+        if kill is not None:
+            kill(step=step)
+        x, y = batch_for(step)
+        with tracer.span("drill_step", cat="step", args={"step": step}):
+            params, opt_state, loss = step_fn(
+                params, opt_state, to_global(x, row_sharded), to_global(y, row_sharded)
+            )
+            jax.block_until_ready(loss)
+        if args.ckpt_every and (step + 1) % args.ckpt_every == 0:
+            with tracer.span("drill_checkpoint", cat="ckpt", args={"step": step + 1}):
+                save_sharded_checkpoint(
+                    args.ckpt_dir,
+                    {
+                        "opt": opt_state,
+                        "params": params,
+                        "step": np.int64(step + 1),
+                    },
+                    step + 1,
+                )
+
+    crc = _params_crc(params)
+    print(
+        f"[drill] rank {rank} world {world} final_step {args.steps} "
+        f"loss {float(np.asarray(loss)):.6f} params_crc {crc:08x}",
+        flush=True,
+    )
+    if args.obs_dir:
+        # One Chrome-trace pid track per process (pid = process_index()).
+        tracer.export(Path(args.obs_dir) / f"trace_p{rank}.json")
+    return 0
+
+
+# --------------------------------------------------------------- driver
+
+_CRC_RE = re.compile(
+    r"\[drill\] rank (\d+) world (\d+) final_step (\d+) "
+    r"loss [-0-9.einfa]+ params_crc ([0-9a-f]{8})"
+)
+_RESUME_RE = re.compile(r"\[drill\] rank (\d+) resumed step (\d+) wall ([0-9.]+)")
+
+
+class _Tee(io.TextIOBase):
+    def __init__(self, *sinks):
+        self.sinks = [s for s in sinks if s is not None]
+
+    def write(self, s):
+        for k in self.sinks:
+            k.write(s)
+        return len(s)
+
+    def flush(self):
+        for k in self.sinks:
+            k.flush()
+
+
+def _parse_crcs(log: str) -> dict[int, str]:
+    return {int(m.group(1)): m.group(4) for m in _CRC_RE.finditer(log)}
+
+
+def _parse_resumes(log: str) -> list[tuple[int, int, float]]:
+    return [
+        (int(m.group(1)), int(m.group(2)), float(m.group(3)))
+        for m in _RESUME_RE.finditer(log)
+    ]
+
+
+def run_drill(
+    base_dir: str,
+    *,
+    world: int = 2,
+    steps: int = 20,
+    ckpt_every: int = 5,
+    kill_step: int = 13,
+    kill_rank: int = 1,
+    backoff_s: float = 0.25,
+    timeout_s: float = 600.0,
+    seed: int = 0,
+    sink=None,
+) -> dict:
+    """Run the full drill; returns the MTTR/bit-exactness evidence dict.
+
+    Sequence: (1) uninterrupted ``world``-process run → reference CRC;
+    (2) same job with rank ``kill_rank`` hard-killed at ``kill_step``,
+    supervised by :class:`ElasticController` (restart policy, seeded
+    backoff, fresh coordinator port) → must resume from the newest valid
+    checkpoint and finish with the *same* CRC; (3) merge the per-rank
+    traces into one document and check one pid track per process.
+    ``ok`` in the result is the drill verdict the CLI / tests gate on.
+    """
+    from tpudml.elastic.controller import ElasticController
+    from tpudml.launch.cluster import ClusterSpec
+    from tpudml.launch.launcher import launch
+    from tpudml.obs.tracer import merge_chrome_traces, validate_chrome_trace
+
+    base = Path(base_dir)
+    base.mkdir(parents=True, exist_ok=True)
+    obs_dir = base / "obs"
+    child = [
+        sys.executable, "-u", "-m", "tpudml.elastic.drill",
+        "--steps", str(steps),
+        "--ckpt_every", str(ckpt_every),
+        "--seed", str(seed),
+        "--obs_dir", str(obs_dir),
+    ]
+    spec = ClusterSpec(num_processes=world, timeout_s=timeout_s, grace_s=3.0)
+
+    # (1) the uninterrupted reference run.
+    clean_log = io.StringIO()
+    clean = launch(
+        child + ["--ckpt_dir", str(base / "clean_ckpt")],
+        spec,
+        sink=_Tee(clean_log, sink),
+    )
+    clean_crcs = _parse_crcs(clean_log.getvalue())
+
+    # (2) the drill run: adversary armed, controller supervising.
+    marker = base / "kill.marker"
+    drill_cmd = child + [
+        "--ckpt_dir", str(base / "drill_ckpt"),
+        "--kill_step", str(kill_step),
+        "--kill_rank", str(kill_rank),
+        "--kill_marker", str(marker),
+    ]
+    drill_log = io.StringIO()
+    ctrl = ElasticController(
+        drill_cmd,
+        dataclasses.replace(
+            spec,
+            restart_backoff_s=backoff_s,
+            restart_backoff_jitter=0.5,
+            restart_backoff_seed=seed,
+        ),
+        policy="restart",
+        max_reforms=2,
+        sink=_Tee(drill_log, sink),
+    )
+    eres = ctrl.run()
+    drill_crcs = _parse_crcs(drill_log.getvalue())
+    resumes = _parse_resumes(drill_log.getvalue())
+
+    # (3) per-process trace evidence: the final (successful) incarnation's
+    # ranks each exported their own pid track.
+    pids: list[int] = []
+    trace_ok = False
+    trace_files = sorted(obs_dir.glob("trace_p*.json"))
+    if trace_files:
+        try:
+            merged = merge_chrome_traces(
+                [json.loads(p.read_text()) for p in trace_files]
+            )
+            validate_chrome_trace(merged)
+            (obs_dir / "trace.json").write_text(
+                json.dumps(merged, sort_keys=True, separators=(",", ":")) + "\n"
+            )
+            pids = sorted(
+                {e["pid"] for e in merged["traceEvents"] if e["ph"] != "M"}
+            )
+            trace_ok = pids == list(range(world))
+        except ValueError:
+            trace_ok = False
+
+    # MTTR accounting, anchored on wall clocks: the failed round's end
+    # (containment complete) → the last rank's resume print.
+    steps_lost = None
+    restart_latency_s = None
+    resume_step = None
+    if resumes and len(eres.records) >= 2:
+        resume_step = min(s for _, s, _ in resumes)
+        steps_lost = kill_step - resume_step
+        restart_latency_s = max(w for _, _, w in resumes) - eres.records[0].t_end
+    ports = [r.coordinator_port for r in eres.records]
+    bit_exact = (
+        len(clean_crcs) == world
+        and len(drill_crcs) == world
+        and len({*clean_crcs.values(), *drill_crcs.values()}) == 1
+    )
+    ok = (
+        clean.success
+        and eres.success
+        and eres.reforms == 1
+        and bit_exact
+        and steps_lost is not None
+        and steps_lost >= 0
+        and len(set(ports)) == len(ports)
+        and trace_ok
+    )
+    return {
+        "ok": ok,
+        "bit_exact": bit_exact,
+        "world": world,
+        "steps": steps,
+        "kill_step": kill_step,
+        "kill_rank": kill_rank,
+        "killed_rank_observed": eres.records[0].failed_rank
+        if eres.records
+        else None,
+        "resume_step": resume_step,
+        "steps_lost": steps_lost,
+        "reforms": eres.reforms,
+        "coordinator_ports": ports,
+        "fresh_port": len(set(ports)) == len(ports),
+        "backoff_s": eres.records[-1].backoff_s if eres.reforms else 0.0,
+        "restart_latency_s": restart_latency_s,
+        "clean_wall_s": clean.elapsed_s,
+        "drill_wall_s": eres.total_elapsed_s,
+        "overhead_vs_clean_frac": (
+            (eres.total_elapsed_s - clean.elapsed_s) / clean.elapsed_s
+            if clean.elapsed_s
+            else None
+        ),
+        "params_crc": next(iter(clean_crcs.values()), None),
+        "trace_pids": pids,
+    }
+
+
+if __name__ == "__main__":
+    sys.exit(child_main())

@@ -23,6 +23,52 @@ import sys
 from tpudml.launch.cluster import ClusterSpec
 from tpudml.launch.launcher import launch
 
+# ``--check`` child: the smallest real cross-process collective. Each rank
+# holds one row of a ['data']-sharded vector and psums it; a wrong wiring
+# (no gloo → XLA:CPU rejects multi-process computations outright) fails
+# the child, which fails the check.
+_CHECK_CHILD = """
+import numpy as np, jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+from tpudml.core.config import DistributedConfig, MeshConfig
+from tpudml.core.dist import distributed_init, make_mesh, process_index
+from tpudml.parallel.sharding import shard_map_fn
+
+distributed_init(DistributedConfig.from_env())
+mesh = make_mesh(MeshConfig({"data": -1}))
+world = int(np.prod(mesh.devices.shape))
+x = jax.make_array_from_callback(
+    (world,), NamedSharding(mesh, P("data")),
+    lambda idx: np.arange(world, dtype=np.float32)[idx])
+total = shard_map_fn(
+    lambda v: jax.lax.psum(v.sum(), "data"), mesh, (P("data"),), P())(x)
+expect = world * (world - 1) / 2
+assert float(total) == expect, (float(total), expect)
+print(f"[check] rank {process_index()}/{world} psum {float(total)} OK",
+      flush=True)
+"""
+
+
+def run_check(spec: ClusterSpec) -> int:
+    """``python -m tpudml.launch --check``: prove the multi-process CPU
+    wiring (gloo collectives + rendezvous + containment) with a 2-process
+    psum; exit 0 iff every rank computed the correct global sum."""
+    if spec.timeout_s is None:
+        spec.timeout_s = 120.0
+    result = launch([sys.executable, "-u", "-c", _CHECK_CHILD], spec)
+    if result.success:
+        print(
+            f"launch --check: OK ({spec.num_processes}-process cross-host "
+            f"psum in {result.elapsed_s:.1f}s)"
+        )
+        return 0
+    print(
+        f"launch --check: FAILED (rcs={result.returncodes}, "
+        f"timed_out={result.timed_out})",
+        file=sys.stderr,
+    )
+    return 1
+
 
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
@@ -49,8 +95,11 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--max_restarts", type=int, default=None,
                    help="relaunch a failed job up to N times (pair the "
                         "command with --ckpt_dir/--resume to continue)")
+    p.add_argument("--check", action="store_true",
+                   help="no command: run a 2-process gloo psum smoke test "
+                        "of the multi-process wiring and exit 0/1")
     args = p.parse_args(argv)
-    if not cmd:
+    if not cmd and not args.check:
         p.error("no command given; usage: python -m tpudml.launch [opts] -- cmd ...")
 
     spec = ClusterSpec.from_json(args.config) if args.config else ClusterSpec()
@@ -71,6 +120,8 @@ def main(argv: list[str] | None = None) -> int:
     if spec.platform == "none":
         spec.platform = None
 
+    if args.check:
+        return run_check(spec)
     result = launch(cmd, spec)
     if result.timed_out:
         print(f"launch: TIMEOUT after {result.elapsed_s:.1f}s", file=sys.stderr)

@@ -32,6 +32,20 @@ class LaunchResult:
         return not self.timed_out and all(rc == 0 for rc in self.returncodes)
 
 
+def restart_backoff(spec: ClusterSpec, rng: random.Random, attempt: int) -> float:
+    """Seeded exponential backoff delay before restart ``attempt`` (1-based):
+    ``restart_backoff_s * factor**(attempt-1)`` plus uniform jitter drawn
+    from ``rng`` — the one backoff schedule shared by :func:`launch`'s
+    whole-job restarts and the elastic controller's re-forms
+    (``tpudml.elastic``), so both are deterministic per (spec, seed)."""
+    if spec.restart_backoff_s <= 0:
+        return 0.0
+    delay = spec.restart_backoff_s * spec.restart_backoff_factor ** (attempt - 1)
+    if spec.restart_backoff_jitter > 0:
+        delay += rng.uniform(0, spec.restart_backoff_jitter * delay)
+    return delay
+
+
 def _substitute(cmd: list[str], rank: int, world: int) -> list[str]:
     """Per-rank command templating: ``{rank}``/``{world}`` placeholders —
     the analogue of compose's per-service ``--rank={0,1}`` lines
@@ -94,22 +108,12 @@ def launch(
     # cadence is reproducible in tests, decorrelated across jobs by seed.
     rng = random.Random(spec.restart_backoff_seed)
 
-    def backoff_for(attempt: int) -> float:
-        if spec.restart_backoff_s <= 0:
-            return 0.0
-        delay = spec.restart_backoff_s * spec.restart_backoff_factor ** (
-            attempt - 1
-        )
-        if spec.restart_backoff_jitter > 0:
-            delay += rng.uniform(0, spec.restart_backoff_jitter * delay)
-        return delay
-
     result = _launch_once(cmd, attempt_spec(budget), sink)
     total_elapsed = result.elapsed_s
     backoffs: list[float] = []
     attempt = 1
     while not result.success and attempt <= spec.max_restarts:
-        delay = backoff_for(attempt)
+        delay = restart_backoff(spec, rng, attempt)
         remaining = None if budget is None else budget - total_elapsed - delay
         if remaining is not None and remaining <= 0:
             break  # whole-job budget exhausted — don't relaunch

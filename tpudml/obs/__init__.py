@@ -19,6 +19,7 @@ from tpudml.obs.tracer import (
     chrome_trace_doc,
     dump_trace,
     get_tracer,
+    merge_chrome_traces,
     set_tracer,
     use_tracer,
     validate_chrome_trace,
@@ -35,6 +36,7 @@ __all__ = [
     "dump_trace",
     "get_tracer",
     "make_step_stats",
+    "merge_chrome_traces",
     "serve_trace_events",
     "set_tracer",
     "use_tracer",

@@ -256,6 +256,35 @@ def dump_trace(doc: dict) -> str:
     return json.dumps(doc, sort_keys=True, separators=(",", ":")) + "\n"
 
 
+def merge_chrome_traces(docs: list[dict]) -> dict:
+    """Merge per-process trace documents (one per rank, distinct pids)
+    into a single multi-track document — the pod-level view of a
+    multi-process run. Each input must be a valid single-process export;
+    two inputs claiming the same pid is an error (two ranks exported with
+    the same ``process_index`` — a wiring bug worth failing loudly on).
+    Deterministic: metadata tracks sorted by pid, then events in the same
+    order :meth:`Tracer.trace_events` uses, pid as the leading key."""
+    metas: dict[int, dict] = {}
+    events: list[dict] = []
+    for doc in docs:
+        validate_chrome_trace(doc)
+        for e in doc["traceEvents"]:
+            if e["ph"] == "M":
+                if e["pid"] in metas:
+                    raise ValueError(
+                        f"duplicate pid {e['pid']} across trace documents"
+                    )
+                metas[e["pid"]] = e
+            else:
+                events.append(e)
+    events.sort(key=lambda e: (e["pid"],) + _sort_key(e))
+    return {
+        "displayTimeUnit": "ms",
+        "metadata": {"tpudml_trace_schema": TRACE_SCHEMA_VERSION},
+        "traceEvents": [metas[p] for p in sorted(metas)] + events,
+    }
+
+
 def validate_chrome_trace(doc: dict) -> None:
     """Schema check for an exported trace document: raises ValueError on
     the first violation of the Chrome trace-event contract the tests (and
