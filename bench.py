@@ -1188,24 +1188,58 @@ def main_obs() -> None:
     print(json.dumps(bench_obs(on_tpu)))
 
 
-def bench_drill() -> dict:
-    """MTTR row for the elastic failure drill (``tpudml.elastic``): run
-    the 2-process gloo training job once uninterrupted and once with rank
-    1 hard-killed mid-run under the elastic controller, and report what
-    the failure actually cost — steps lost to the checkpoint cadence,
-    restart latency (containment → resumed, including the seeded
-    backoff), and wall-clock overhead vs the clean run — plus the
-    bit-exactness verdict that makes the recovery trustworthy."""
+def bench_drill(*, shrink: bool = True, naive: bool = False) -> dict:
+    """MTTR row for the elastic failure drills (``tpudml.elastic``).
+
+    ``shrink=False`` is the PR 14 restart drill: 2-process gloo job run
+    once uninterrupted and once with rank 1 hard-killed under the
+    controller's restart policy, reporting steps lost, restart latency,
+    and the bit-exactness verdict.
+
+    ``shrink=True`` (the default) is the adaptive-recovery drill: the
+    kill shrinks the gang, the controller consults the planner at the
+    new world, and the run resumes under a *different* engine chain —
+    the row grows the re-plan evidence (old/new chain, plan latency,
+    receipts, post-shrink throughput). ``naive=True`` adds the A/B arm
+    that forces the OLD chain at the shrunken world via explicit CLI
+    flags, so ``replan_beats_naive`` is measured, not claimed."""
     import tempfile
 
-    from tpudml.elastic.drill import run_drill
+    base = tempfile.mkdtemp(prefix="tpudml_bench_drill_")
+    if not shrink:
+        from tpudml.elastic.drill import run_drill
 
-    rep = run_drill(tempfile.mkdtemp(prefix="tpudml_bench_drill_"))
-    return {
-        "bench": "elastic_drill",
+        rep = run_drill(base)
+        return {
+            "bench": "elastic_drill",
+            "ok": rep["ok"],
+            "bit_exact": rep["bit_exact"],
+            "world": rep["world"],
+            "steps": rep["steps"],
+            "kill_step": rep["kill_step"],
+            "resume_step": rep["resume_step"],
+            "steps_lost": rep["steps_lost"],
+            "reforms": rep["reforms"],
+            "backoff_s": round(rep["backoff_s"], 3),
+            "restart_latency_s": round(rep["restart_latency_s"], 3)
+            if rep["restart_latency_s"] is not None
+            else None,
+            "clean_wall_s": round(rep["clean_wall_s"], 3),
+            "drill_wall_s": round(rep["drill_wall_s"], 3),
+            "overhead_vs_clean_frac": round(rep["overhead_vs_clean_frac"], 4)
+            if rep["overhead_vs_clean_frac"] is not None
+            else None,
+        }
+
+    from tpudml.elastic.drill import run_shrink_drill
+
+    rep = run_shrink_drill(base, include_naive=naive)
+    row = {
+        "bench": "elastic_shrink_drill",
         "ok": rep["ok"],
         "bit_exact": rep["bit_exact"],
         "world": rep["world"],
+        "final_world": rep["final_world"],
         "steps": rep["steps"],
         "kill_step": rep["kill_step"],
         "resume_step": rep["resume_step"],
@@ -1215,19 +1249,39 @@ def bench_drill() -> dict:
         "restart_latency_s": round(rep["restart_latency_s"], 3)
         if rep["restart_latency_s"] is not None
         else None,
-        "clean_wall_s": round(rep["clean_wall_s"], 3),
         "drill_wall_s": round(rep["drill_wall_s"], 3),
-        "overhead_vs_clean_frac": round(rep["overhead_vs_clean_frac"], 4)
-        if rep["overhead_vs_clean_frac"] is not None
+        # The re-plan evidence: what chain we left, what chain we
+        # resumed under, how long the decision took, and why the old
+        # config lost (machine-readable receipts).
+        "old_chain": rep["old_plan"],
+        "new_chain": rep["new_plan"],
+        "plan_switched": rep["plan_switched"],
+        "chain_switched": rep["chain_switched"],
+        "replan_latency_s": round(rep["replan_latency_s"], 4)
+        if rep["replan_latency_s"] is not None
         else None,
+        "replan_receipts": [r["verdict"] for r in rep["replan_receipts"]],
+        "post_shrink_steps_per_s": rep["post_shrink_steps_per_s"],
     }
+    if naive:
+        row["naive"] = rep["naive"]
+        row["replan_beats_naive"] = rep["replan_beats_naive"]
+    return row
 
 
 def main_drill() -> None:
     """Driver for ``python bench.py --drill``: prints ONE JSON line, same
-    contract as ``main()``, for the elastic MTTR row. Requires a platform
-    where the 2-process drill can run (JAX_PLATFORMS=cpu uses gloo)."""
-    print(json.dumps(bench_drill()))
+    contract as ``main()``, for the elastic MTTR row — by default the
+    shrink-re-plan drill. ``--drill-restart`` runs the plain restart
+    drill instead; ``--drill-naive`` adds the old-chain-at-new-world A/B
+    arm. Requires a platform where the multi-process drill can run
+    (JAX_PLATFORMS=cpu uses gloo)."""
+    import sys
+
+    print(json.dumps(bench_drill(
+        shrink="--drill-restart" not in sys.argv[1:],
+        naive="--drill-naive" in sys.argv[1:],
+    )))
 
 
 def main_serve() -> None:
@@ -1330,7 +1384,7 @@ if __name__ == "__main__":
         main_sentinel()
     elif "--obs" in sys.argv[1:]:
         main_obs()
-    elif "--drill" in sys.argv[1:]:
+    elif any(a.startswith("--drill") for a in sys.argv[1:]):
         main_drill()
     else:
         main()

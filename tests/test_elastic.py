@@ -1,18 +1,27 @@
-"""tpudml.elastic: membership-aware restart + the scripted failure drill.
+"""tpudml.elastic: membership-aware restart + adaptive re-plan + drills.
 
-Controller semantics (policy, fresh rendezvous port, budget, min_world)
-are pinned with jax-free subprocess children, so they run in seconds; the
-full drill — real gloo collectives, SIGKILL-grade rank death, bit-exact
-resume — is the e2e capstone and carries the multi-OS-process cost.
+Controller semantics (policy, fresh rendezvous port, budget, min_world,
+re-plan consultation) are pinned with jax-free subprocess children and
+stub replanners, so they run in seconds; fixture replay exercises the
+real planner meshlessly. The shrink-re-plan drill — real gloo
+collectives, SIGKILL-grade rank death, planner-driven engine-chain
+switch, bit-exact resume — is the e2e capstone and the one test here
+that carries the multi-OS-process cost tier-1 (the PR 14 restart drill
+is demoted to the slow tier: the shrink drill supersedes its coverage).
 """
 
 import io
+import json
+import os
 import sys
+from pathlib import Path
 
 import pytest
 
 from tpudml.elastic.controller import ROUND_ENV, ElasticController
 from tpudml.launch.cluster import ClusterSpec
+
+FIXTURES = Path(__file__).parent / "elastic_fixtures"
 
 PY = sys.executable
 
@@ -113,6 +122,320 @@ def test_bad_policy_rejected():
         ElasticController([PY, "-c", "pass"], policy="resurrect")
 
 
+# ------------------------------------------------------- port reservation
+
+
+def test_fresh_port_reservation_is_bind_and_hold():
+    """The fresh-port path must HOLD the socket it picked (not
+    bind-close-return, which races any other process grabbing ephemeral
+    ports between the close and the child's bind)."""
+    from tpudml.resilience.faults import occupy_port
+
+    ctrl = ElasticController([PY, "-c", "pass"], ClusterSpec(num_processes=1))
+    sock, port = ctrl._reserve_fresh_port(set())
+    try:
+        with pytest.raises(OSError):  # held: nobody else can take it
+            occupy_port(port)
+    finally:
+        sock.close()
+    occupy_port(port).close()  # released: bindable again
+
+
+def test_pinned_port_collision_falls_back_to_fresh_port():
+    """Regression for the coordinator-port race: a squatter on the
+    pinned port must push the controller to a fresh port, not a
+    crash-loop of bind failures."""
+    from tpudml.resilience.faults import occupy_port
+
+    squat = occupy_port(0)
+    try:
+        port = squat.getsockname()[1]
+        sink = io.StringIO()
+        res = ElasticController(
+            _child("sys.exit(0)\n"),
+            ClusterSpec(num_processes=2, coordinator_port=port,
+                        timeout_s=60.0, grace_s=1.0),
+            sink=sink,
+        ).run()
+        assert res.success
+        assert res.records[0].coordinator_port != port
+        assert "falling back to a fresh port" in sink.getvalue()
+    finally:
+        squat.close()
+
+
+# ---------------------------------------------------- re-plan consultation
+
+
+class _StubReplanner:
+    """Records consultations; returns a plain-dict decision (the
+    controller accepts any object with .to_dict() or dict(...))."""
+
+    def __init__(self):
+        self.calls = []
+
+    def replan(self, world, *, why="membership change", trigger="membership"):
+        self.calls.append((world, why))
+        return {
+            "trigger": trigger, "why": why,
+            "old_world": world + 1, "new_world": world,
+            "old_key": "zero1[data=2]", "new_key": "dp[data=1]",
+            "switched": True, "latency_s": 0.01,
+            "receipts": [{"verdict": "infeasible_at_world"}],
+            "calibration": None, "error": None,
+        }
+
+
+class _ExplodingReplanner:
+    def replan(self, world, **_):
+        raise RuntimeError("boom")
+
+
+def test_shrink_consults_replanner_and_records_decision():
+    cmd = _child(
+        "if rnd == 0 and rank == 1:\n"
+        "    sys.exit(4)\n"
+        "sys.exit(0)\n"
+    )
+    rp = _StubReplanner()
+    sink = io.StringIO()
+    res = ElasticController(
+        cmd, ClusterSpec(num_processes=2, timeout_s=60.0, grace_s=1.0),
+        policy="shrink", min_world=1, max_reforms=2, replanner=rp, sink=sink,
+    ).run()
+    assert res.success
+    assert [w for w, _ in rp.calls] == [1]
+    assert "rank 1" in rp.calls[0][1]  # the membership why reaches the planner
+    assert len(res.replans) == 1
+    rep = res.replans[0]
+    assert rep["round"] == 1 and rep["new_world"] == 1
+    assert rep["switched"] and rep["error"] is None
+    assert rep["receipts"][0]["verdict"] == "infeasible_at_world"
+    assert "engine chain switched" in sink.getvalue()
+    assert res.to_dict()["replans"] == res.replans
+
+
+def test_restart_policy_does_not_consult_replanner():
+    """World unchanged → no membership change → no re-plan."""
+    cmd = _child(
+        "if rnd == 0 and rank == 1:\n"
+        "    sys.exit(4)\n"
+        "sys.exit(0)\n"
+    )
+    rp = _StubReplanner()
+    res = ElasticController(
+        cmd, ClusterSpec(num_processes=2, timeout_s=60.0, grace_s=1.0),
+        policy="restart", max_reforms=2, replanner=rp, sink=io.StringIO(),
+    ).run()
+    assert res.success and res.reforms == 1
+    assert rp.calls == [] and res.replans == []
+
+
+def test_replanner_failure_does_not_kill_recovery():
+    """Fail-open: a planner crash during recovery is recorded and the
+    re-form proceeds under the old plan."""
+    cmd = _child(
+        "if rnd == 0 and rank == 1:\n"
+        "    sys.exit(4)\n"
+        "sys.exit(0)\n"
+    )
+    sink = io.StringIO()
+    res = ElasticController(
+        cmd, ClusterSpec(num_processes=2, timeout_s=60.0, grace_s=1.0),
+        policy="shrink", min_world=1, max_reforms=2,
+        replanner=_ExplodingReplanner(), sink=sink,
+    ).run()
+    assert res.success and res.reforms == 1
+    assert len(res.replans) == 1
+    assert "RuntimeError: boom" in res.replans[0]["error"]
+    assert "keeping the old plan" in sink.getvalue()
+
+
+def test_reform_survives_straggler_rejoiner():
+    """A rank that stalls while rejoining the re-formed gang delays but
+    does not break the round (the launcher waits the gang out)."""
+    cmd = _child(
+        "if rnd == 0 and rank == 1:\n"
+        "    sys.exit(3)\n"
+        "if rnd == 1 and rank == 0:\n"
+        "    time.sleep(1.5)\n"
+        "sys.exit(0)\n"
+    )
+    res = ElasticController(
+        cmd, ClusterSpec(num_processes=2, timeout_s=60.0, grace_s=1.0),
+        max_reforms=2, sink=io.StringIO(),
+    ).run()
+    assert res.success and res.reforms == 1
+    assert res.records[1].elapsed_s >= 1.0  # the straggle was real
+
+
+def test_reform_straggler_hook_gates_on_round_and_rank(monkeypatch):
+    from tpudml.resilience import faults
+
+    slept = []
+    monkeypatch.setattr(faults.time, "sleep", lambda s: slept.append(s))
+    monkeypatch.setenv("TPUDML_PROCESS_ID", "1")
+    monkeypatch.setenv("TPUDML_ELASTIC_ROUND", "0")
+    hook = faults.reform_straggler_hook(2.0, round=1, rank=1)
+    hook(step=0)
+    assert slept == []  # wrong round
+    monkeypatch.setenv("TPUDML_ELASTIC_ROUND", "1")
+    monkeypatch.setenv("TPUDML_PROCESS_ID", "0")
+    hook(step=0)
+    assert slept == []  # wrong rank
+    monkeypatch.setenv("TPUDML_PROCESS_ID", "1")
+    hook(step=0)
+    hook(step=1)
+    assert slept == [2.0]  # fired exactly once
+
+
+# -------------------------------------------------- replanner + plan file
+
+
+def test_real_replanner_fails_open_when_no_candidate_fits():
+    """zero1-only lattice has no mesh at world 1: the re-plan records
+    the error and keeps the old plan instead of raising mid-recovery."""
+    from tpudml.elastic.replan import Replanner
+
+    rp = Replanner(engines=["zero1"], verify=False)
+    rp.initial_plan(2)
+    old_key = rp.winner_key
+    rec = rp.replan(1, why="shrink to 1")
+    assert rec.error is not None
+    assert rp.winner_key == old_key  # plan unchanged
+    assert rp.plan["world"] == 2
+
+
+def test_vandalized_plan_degrades_to_replan_from_scratch(tmp_path):
+    """Every plan vandal (torn write, garbage bytes, bad version) must
+    make load_existing return None — never half-adopt a broken plan —
+    and a fresh plan from scratch must still come out."""
+    from tpudml.elastic.replan import Replanner
+    from tpudml.resilience.faults import PLAN_VANDALS, vandalize_plan
+
+    for kind in PLAN_VANDALS:
+        path = tmp_path / f"{kind}.json"
+        Replanner(engines=["dp", "zero1"], verify=False,
+                  plan_path=path).initial_plan(2)
+        vandalize_plan(str(path), kind)
+        rp = Replanner(engines=["dp", "zero1"], verify=False)
+        assert rp.load_existing(path) is None, kind
+        assert rp.plan is None
+        assert rp.initial_plan(2)["winner"]["candidate"]["key"]
+
+    # Control: an intact plan file IS adopted.
+    path = tmp_path / "intact.json"
+    Replanner(engines=["dp", "zero1"], verify=False,
+              plan_path=path).initial_plan(2)
+    rp = Replanner(engines=["dp", "zero1"], verify=False)
+    assert rp.load_existing(path)["world"] == 2
+
+
+# ---------------------------------------------------------- fixture replay
+
+
+def test_fixture_replay_drift_fires_and_calibrates():
+    """The committed shrink+drift fixture: membership re-plans produce
+    receipts, the >10% drift event fires and folds the measured
+    constants into the plan's calibration block."""
+    from tpudml.elastic.replan import replay_fixture
+
+    rep = replay_fixture(FIXTURES / "shrink_then_drift.json")
+    assert rep["ok"]
+    assert rep["events"] == 3
+    assert rep["drift_checks"] == 1 and rep["drift_firings"] == 1
+    assert rep["plan_switches"] == 2  # 4→2 re-mesh, 2→1 chain switch
+    drift_recs = [r for r in rep["replans"] if r["trigger"] == "drift"]
+    assert len(drift_recs) == 1
+    assert drift_recs[0]["calibration"]["comm_scale"] == pytest.approx(1.25)
+    assert rep["final"]["calibration"]["comm_scale"] == pytest.approx(1.25)
+    verdicts = [c["verdict"] for r in rep["replans"] for c in r["receipts"]]
+    assert "infeasible_at_world" in verdicts  # zero1 at world 1
+    assert "retained" in verdicts  # zero1 at world 2
+    assert rep["final"]["engine_config"]["engine"] == "dp"
+
+
+def test_fixture_replay_fresh_report_does_not_replan():
+    """In-threshold drift → no re-plan, no calibration: the runtime
+    trigger has no false positives."""
+    from tpudml.elastic.replan import replay_fixture
+
+    rep = replay_fixture(FIXTURES / "fresh.json")
+    assert rep["ok"]
+    assert rep["drift_checks"] == 1 and rep["drift_firings"] == 0
+    assert rep["replans"] == [] and rep["plan_switches"] == 0
+    assert rep["final"]["calibration"] is None
+    assert rep["final"]["winner"] == rep["initial"]["winner"]
+
+
+def test_fixture_cli_replays_without_spawning(tmp_path):
+    """``python -m tpudml.elastic --drill --fixture ...`` is the
+    meshless CI mode: one process, no gang spawned, exit code is the
+    replay verdict."""
+    import subprocess
+
+    proc = subprocess.run(
+        [PY, "-m", "tpudml.elastic", "--drill",
+         "--fixture", str(FIXTURES / "shrink_then_drift.json")],
+        capture_output=True, text=True, timeout=120,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 0, proc.stderr
+    report = json.loads(proc.stdout.splitlines()[-1])
+    assert report["ok"] and report["drift_firings"] == 1
+    assert "[replay]" in proc.stderr  # narration goes to stderr
+
+
+def test_fixture_version_gate(tmp_path):
+    from tpudml.elastic.replan import replay_fixture
+
+    bad = dict(json.loads((FIXTURES / "fresh.json").read_text()), version=7)
+    with pytest.raises(ValueError, match="fixture version"):
+        replay_fixture(bad)
+
+
+# -------------------------------------------------------------- e2e drills
+
+
+def test_shrink_replan_drill_e2e(tmp_path):
+    """The PR 16 tentpole e2e: 2-process ZeRO-1+accum training (chain
+    chosen by the planner via --plan), rank 1 hard-killed at step 13 →
+    shrink to world 1 → planner consulted (ZeRO-1 infeasible on one
+    chip, receipts say so) → resume from the CRC-valid sharded
+    checkpoint under plain DP → final params AND loss history bit-exact
+    vs an uninterrupted world-1 DP run from the same checkpoint."""
+    from tpudml.elastic.drill import run_shrink_drill
+
+    report = run_shrink_drill(str(tmp_path), timeout_s=300.0)
+    assert report["ok"], report
+    assert report["bit_exact"]
+    assert report["reforms"] == 1 and report["final_world"] == 1
+    assert report["killed_rank_observed"] == 1
+    assert report["old_plan"]["engine"] == "zero1"
+    assert report["old_plan"]["accum_steps"] == 2
+    assert report["new_plan"]["engine"] == "dp"
+    assert report["plan_switched"] and report["chain_switched"]
+    assert report["resume_step"] == 10 and report["steps_lost"] == 3
+    assert [r["verdict"] for r in report["replan_receipts"]] == [
+        "infeasible_at_world"
+    ]
+    assert report["fresh_port"]
+    assert report["replan_latency_s"] is not None
+    assert report["post_shrink_steps_per_s"] > 0
+    # The artifacts the obs report reads.
+    assert (tmp_path / "obs" / "elastic.json").exists()
+    assert (tmp_path / "obs" / "trace_controller.json").exists()
+    # plan.json on disk is the re-planned v2 plan the continuation ran
+    # under, provenance block included.
+    plan = json.loads((tmp_path / "plan.json").read_text())
+    assert plan["version"] == 2
+    assert plan["world"] == 1
+    assert plan["engine_config"]["engine"] == "dp"
+    assert plan["replan"]["trigger"] == "membership"
+    assert plan["replan"]["old_winner"]["engine"] == "zero1"
+
+
+@pytest.mark.slow
 def test_drill_kill_reform_resume_bit_exact(tmp_path):
     """The tentpole e2e: 2-process gloo training, rank 1 hard-killed at
     step 13 → controller re-forms on a fresh port after seeded backoff →

@@ -337,3 +337,104 @@ def test_train_config_merges_plan_engine_config(plan4, tmp_path):
     cfg = config_from_args(build_parser().parse_args(
         ["--plan", str(path), "--accum_steps", "8"]))
     assert cfg.accum_steps == 8  # explicit flag beats the plan
+
+
+# --------------------------------------------------- v2 schema + calibration
+
+
+def test_plan_v2_schema_keys_always_present(plan4):
+    """v2 totality: calibration/replan are ALWAYS keys (null when unused)
+    — schema shape never depends on how the plan was produced, which is
+    what keeps byte-determinism trivial."""
+    from tpudml.plan import PLAN_VERSION
+
+    assert plan4["version"] == PLAN_VERSION == 2
+    assert plan4["calibration"] is None
+    assert plan4["replan"] is None
+
+
+def test_v1_plan_still_loads(plan4, tmp_path):
+    """Back-compat: a v1 plan.json (no calibration/replan keys) loads
+    and is upgraded in-memory to the v2 shape."""
+    from tpudml.plan import load_plan
+
+    v1 = {k: v for k, v in plan4.items() if k not in ("calibration", "replan")}
+    v1["version"] = 1
+    path = tmp_path / "v1_plan.json"
+    path.write_text(json.dumps(v1, indent=2, sort_keys=True) + "\n")
+    plan = load_plan(str(path))
+    assert plan["version"] == 1
+    assert plan["calibration"] is None and plan["replan"] is None
+    assert plan["winner"] == plan4["winner"]
+
+
+def test_calibrated_plan_is_byte_deterministic(tmp_path):
+    from tpudml.plan import Calibration, flagship_lm, load_plan, make_plan, plan_to_json
+
+    cal = Calibration(comm_scale=1.25, source="obs/drift")
+    replan = {"trigger": "drift", "why": "test", "old_world": 4,
+              "old_winner": {}, "receipts": []}
+    a = make_plan(flagship_lm(), 4, verify=False, calibration=cal,
+                  replan=dict(replan))
+    b = make_plan(flagship_lm(), 4, verify=False, calibration=cal,
+                  replan=dict(replan))
+    assert plan_to_json(a) == plan_to_json(b)
+    assert a["calibration"]["comm_scale"] == 1.25
+    path = tmp_path / "plan.json"
+    path.write_text(plan_to_json(a))
+    assert load_plan(str(path)) == json.loads(plan_to_json(a))
+
+
+def test_calibration_scales_the_roofline_terms():
+    """comm_scale multiplies every comm term, hbm_scale the HBM estimate
+    — monotonically, so a measured-slower network can only demote
+    comm-heavy candidates, never spuriously promote them."""
+    from tpudml.plan import flagship_lm, score_candidate
+    from tpudml.plan.score import Calibration
+    from tpudml.plan.space import enumerate_candidates
+
+    spec = flagship_lm()
+    cand = next(c for c in enumerate_candidates(4, engines=["zero1"])
+                if c.zero1 and not c.zero1_overlap)
+    base = score_candidate(spec, cand)
+    cal = score_candidate(spec, cand,
+                          calibration=Calibration(comm_scale=2.0))
+    assert cal.comm_wire_bytes == pytest.approx(2.0 * base.comm_wire_bytes)
+    assert (cal.exposed_comm_s + cal.hidden_comm_s) == pytest.approx(
+        2.0 * (base.exposed_comm_s + base.hidden_comm_s))
+    assert cal.step_time_s > base.step_time_s
+    assert cal.compute_s == base.compute_s  # comm scale touches only comm
+    hbm = score_candidate(spec, cand,
+                          calibration=Calibration(hbm_scale=1.5))
+    assert hbm.est_hbm_bytes == pytest.approx(1.5 * base.est_hbm_bytes, rel=1e-6)
+
+
+def test_calibration_fit_and_roundtrip():
+    from tpudml.plan import Calibration
+
+    records = [
+        {"entrypoint": "a", "static_wire_bytes": 1.0e6,
+         "measured_wire_bytes": 1.25e6, "rel_err": 0.2},
+        {"entrypoint": "b", "static_wire_bytes": 4.0e5,
+         "measured_wire_bytes": 5.0e5, "rel_err": 0.2},
+    ]
+    cal = Calibration.from_drift_records(records)
+    assert cal.comm_scale == pytest.approx(1.75e6 / 1.4e6)
+    assert len(cal.basis) == 2
+    assert Calibration.from_dict(cal.to_dict()).comm_scale == cal.comm_scale
+
+
+def test_world1_enumeration_is_dp_only():
+    """World 1: only plain DP is enumerable — sharding chains (zero1 /
+    fsdp / tp) have nothing to shard, so the planner reports them as
+    infeasible rather than scoring degenerate single-chip variants."""
+    from tpudml.plan import flagship_lm, make_plan
+    from tpudml.plan.space import enumerate_candidates
+
+    cands = enumerate_candidates(1)
+    assert cands
+    assert {c.engine for c in cands} == {"dp"}
+
+    plan = make_plan(flagship_lm(), 1, engines=["dp", "zero1"], verify=False)
+    assert plan["winner"]["candidate"]["engine"] == "dp"
+    assert plan["winner"]["candidate"]["mesh"] == {"data": 1}

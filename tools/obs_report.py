@@ -8,7 +8,10 @@ any subset is fine; missing files just skip their section:
 - ``trace.json``     — the Chrome-trace-event export (per-category span
   count / total / p50 / p99);
 - ``obs/drift.json`` — the static-vs-measured drift report
-  (``python -m tpudml.obs --check-drift --out ...``).
+  (``python -m tpudml.obs --check-drift --out ...``);
+- ``elastic.json``   — the elastic controller's reform/re-plan history
+  (rounds, ports, backoffs, plan switches + receipts), plus any
+  ``elastic``-category instants in the exported traces.
 
 Usage::
 
@@ -96,12 +99,98 @@ def drift_summary(path: Path) -> str | None:
     return format_drift_table(json.loads(path.read_text()))
 
 
+def elastic_summary(run_dir: Path) -> str | None:
+    """Reform/re-plan history from the elastic controller's artifacts:
+    ``elastic.json`` (ElasticResult: one row per round, one per re-plan
+    decision) plus any ``elastic``-category instants found in the
+    exported traces (``trace_controller.json`` / ``trace.json``)."""
+    path = run_dir / "elastic.json"
+    if not path.is_file():
+        return None
+    res = json.loads(path.read_text())
+    out = [
+        f"outcome: {res.get('stop_reason', '?')}  "
+        f"success={res.get('success')}  reforms={res.get('reforms')}  "
+        f"final_world={res.get('final_world')}  "
+        f"wall={res.get('total_elapsed_s', 0.0):.1f}s"
+    ]
+    rounds = res.get("records") or []
+    if rounds:
+        rows = [
+            [
+                r.get("round"),
+                r.get("world"),
+                r.get("coordinator_port"),
+                r.get("failed_rank") if r.get("failed_rank") is not None else "-",
+                "yes" if r.get("timed_out") else "no",
+                f"{r.get('backoff_s', 0.0):.3f}",
+                f"{r.get('elapsed_s', 0.0):.2f}",
+            ]
+            for r in rounds
+        ]
+        out.append(_table(
+            ["round", "world", "port", "failed_rank", "timed_out",
+             "backoff_s", "elapsed_s"],
+            rows,
+        ))
+    replans = res.get("replans") or []
+    if replans:
+        rows = []
+        for r in replans:
+            verdicts = ",".join(
+                rc.get("verdict", "?") for rc in r.get("receipts", ())
+            ) or "-"
+            rows.append([
+                r.get("round", "-"),
+                r.get("trigger"),
+                f"{r.get('old_world')}→{r.get('new_world')}",
+                r.get("old_key"),
+                r.get("new_key"),
+                "yes" if r.get("switched") else "no",
+                f"{r.get('latency_s', 0.0) * 1e3:.1f}",
+                verdicts,
+                (r.get("error") or "-"),
+            ])
+        out.append(_table(
+            ["round", "trigger", "world", "old plan", "new plan",
+             "switched", "plan_ms", "receipts", "error"],
+            rows,
+        ))
+    else:
+        out.append("(no re-plans recorded)")
+    # Controller-side instants, if a trace was exported alongside.
+    instants = []
+    for name in ("trace_controller.json", "trace.json"):
+        tpath = run_dir / name
+        if not tpath.is_file():
+            continue
+        try:
+            doc = json.loads(tpath.read_text())
+        except ValueError:
+            continue
+        instants += [
+            e for e in doc.get("traceEvents", [])
+            if e.get("ph") == "i" and e.get("cat") == "elastic"
+        ]
+    if instants:
+        rows = [
+            [
+                e.get("name"),
+                json.dumps(e.get("args", {}), sort_keys=True),
+            ]
+            for e in sorted(instants, key=lambda e: e.get("ts", 0))
+        ]
+        out.append(_table(["instant", "args"], rows))
+    return "\n\n".join(out)
+
+
 def report(run_dir: str | Path) -> str:
     run_dir = Path(run_dir)
     sections = [
         ("metrics.jsonl", metrics_summary(run_dir / "metrics.jsonl")),
         ("trace.json", trace_summary(run_dir / "trace.json")),
         ("obs/drift.json", drift_summary(run_dir / "obs" / "drift.json")),
+        ("elastic.json (reform/re-plan)", elastic_summary(run_dir)),
     ]
     out = [f"== obs report: {run_dir} =="]
     found = False

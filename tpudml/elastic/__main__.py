@@ -1,9 +1,22 @@
-"""CLI: ``python -m tpudml.elastic`` — elastic supervision + the drill.
+"""CLI: ``python -m tpudml.elastic`` — elastic supervision + the drills.
 
-Drill mode (the acceptance gate — exits 0 iff the kill→re-form→resume
-sequence reproduced the uninterrupted run bit-exactly)::
+Drill modes (the acceptance gates — exit 0 iff the verdict holds):
+
+- restart drill (kill→re-form→resume, bit-exact vs uninterrupted)::
 
     JAX_PLATFORMS=cpu python -m tpudml.elastic --drill
+
+- shrink-re-plan drill (kill→shrink→planner consulted at the new world→
+  resume under a DIFFERENT engine chain, bit-exact vs a reference run of
+  that chain from the same checkpoint)::
+
+    JAX_PLATFORMS=cpu python -m tpudml.elastic --drill --shrink
+
+- fixture replay (meshless CI mode: no processes spawned, no mesh —
+  replays a pre-recorded membership/drift event stream through the
+  Replanner and prints the re-plan/receipt/calibration report)::
+
+    python -m tpudml.elastic --drill --fixture tests/elastic_fixtures/shrink_drift.json
 
 Supervision mode (the elastic counterpart of ``python -m tpudml.launch``:
 re-forms on failure instead of plain relaunch)::
@@ -21,7 +34,6 @@ import sys
 import tempfile
 
 from tpudml.elastic.controller import ElasticController
-from tpudml.elastic.drill import run_drill
 from tpudml.launch.cluster import ClusterSpec
 
 
@@ -36,6 +48,16 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--drill", action="store_true",
                    help="run the scripted failure drill; exit 0 iff the "
                         "resumed run is bit-identical to an uninterrupted one")
+    p.add_argument("--shrink", action="store_true",
+                   help="with --drill: the shrink-re-plan drill (planner "
+                        "consulted at the new world, chain switch required)")
+    p.add_argument("--fixture", type=str, default=None,
+                   help="with --drill: replay a recorded membership/drift "
+                        "event fixture through the Replanner — no processes, "
+                        "no mesh (the CI-friendly mode)")
+    p.add_argument("--naive", action="store_true",
+                   help="with --drill --shrink: also run the A/B arm that "
+                        "forces the OLD chain at the shrunken world")
     p.add_argument("--dir", type=str, default=None,
                    help="drill working dir (default: a fresh temp dir)")
     p.add_argument("--steps", type=int, default=20)
@@ -50,17 +72,42 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--backoff_s", type=float, default=0.0)
     args = p.parse_args(argv)
 
+    if args.drill and args.fixture:
+        from tpudml.elastic.replan import replay_fixture
+
+        with open(args.fixture) as f:
+            fixture = json.load(f)
+        report = replay_fixture(fixture, sink=sys.stderr)
+        print(json.dumps(report, sort_keys=True))
+        return 0 if report["ok"] else 1
+
     if args.drill:
         base = args.dir or tempfile.mkdtemp(prefix="tpudml_drill_")
-        report = run_drill(
-            base,
-            world=args.num_processes,
-            steps=args.steps,
-            ckpt_every=args.ckpt_every,
-            kill_step=args.kill_step,
-            seed=args.seed,
-            backoff_s=args.backoff_s or 0.25,
-        )
+        if args.shrink:
+            from tpudml.elastic.drill import run_shrink_drill
+
+            report = run_shrink_drill(
+                base,
+                world=args.num_processes,
+                steps=args.steps,
+                ckpt_every=args.ckpt_every,
+                kill_step=args.kill_step,
+                seed=args.seed,
+                backoff_s=args.backoff_s or 0.25,
+                include_naive=args.naive,
+            )
+        else:
+            from tpudml.elastic.drill import run_drill
+
+            report = run_drill(
+                base,
+                world=args.num_processes,
+                steps=args.steps,
+                ckpt_every=args.ckpt_every,
+                kill_step=args.kill_step,
+                seed=args.seed,
+                backoff_s=args.backoff_s or 0.25,
+            )
         print(json.dumps(report, sort_keys=True))
         return 0 if report["ok"] else 1
 

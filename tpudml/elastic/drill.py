@@ -1,24 +1,45 @@
-"""The elastic failure drill: kill → backoff → re-form → bit-exact resume.
+"""The elastic failure drills: kill → backoff → re-form → bit-exact resume.
 
-Two halves:
+Three pieces:
 
-- :func:`child_main` — the per-rank training program the drill supervises
+- :func:`child_main` — the per-rank training program the drills supervise
   (``python -m tpudml.elastic.drill``). A deliberately small but *real*
-  multi-process job: gloo-backed cross-process psum DP on a
+  multi-process job: gloo-backed cross-process collectives on a
   ``('data',)`` mesh, batches that are a pure function of the step index
   (so any incarnation replays the same trajectory), sharded CRC-verified
-  checkpoints every k steps, and resume from the newest valid step. A
-  seeded :func:`~tpudml.resilience.faults.rank_kill_hook` plays the
-  adversary: ``os._exit`` mid-training, at most once per drill (marker
-  file). Each rank prints its final parameter CRC and exports its own
-  flight-recorder track (one Chrome-trace pid per process).
+  checkpoints every k steps, and resume from the newest valid step. The
+  child speaks the planner's language: ``--plan plan.json`` picks the
+  engine chain (plain DP, or ZeRO-1 via the real
+  :class:`~tpudml.optim.zero1.ZeRO1` wrapper) and accumulation with the
+  same explicit-CLI-wins precedence the tasks use, and its checkpoints
+  are **chain-agnostic**: always the canonical ``{params, mom, step}``
+  full-parameter layout (ZeRO-1's flat optimizer shards are gathered to
+  parameter shape at save and re-sharded at restore), so any chain at
+  any world restores any other chain's checkpoint. A seeded
+  :func:`~tpudml.resilience.faults.rank_kill_hook` plays the adversary.
+  Each rank prints its final parameter CRC, its executed-loss-history
+  CRC, and its measured steps/s, and exports its own flight-recorder
+  track.
 
-- :func:`run_drill` — the drill driver and the MTTR evidence source: run
-  the job once uninterrupted, once under :class:`ElasticController` with
-  the adversary armed, then require the two final parameter CRCs to be
-  **bit-identical** and report recovery stats (steps lost to the kill,
-  restart latency including backoff, wall-clock overhead vs the
-  uninterrupted run).
+- :func:`run_drill` — the PR 14 restart drill: run the job once
+  uninterrupted, once under :class:`ElasticController` (restart policy)
+  with the adversary armed, then require the two final parameter CRCs to
+  be **bit-identical** and report the MTTR evidence.
+
+- :func:`run_shrink_drill` — the adaptive-recovery drill (PR 16): SIGKILL
+  a rank under the ``shrink`` policy with a
+  :class:`~tpudml.elastic.replan.Replanner` attached. The controller
+  consults the planner at the new world, the planner picks a *different*
+  engine chain (world 2 ZeRO-1+accum → world 1 plain DP — ZeRO-1 shards
+  nothing on one chip), and the next incarnation resumes from the
+  CRC-valid sharded checkpoint under the new chain. The verdict requires
+  the continued run to be bit-exact (params CRC *and* loss-history CRC)
+  against an uninterrupted run of the new chain started from the same
+  checkpoint, and the re-plan receipts to say *why* the old chain lost.
+  Optionally an A/B "naive" arm re-runs the old chain at the shrunken
+  world (explicit ``--engine``/``--accum_steps`` flags overriding the
+  plan — the precedence demo) so "re-planned beats naive" is a measured
+  row.
 """
 
 from __future__ import annotations
@@ -28,6 +49,7 @@ import dataclasses
 import io
 import json
 import re
+import shutil
 import sys
 import time
 import zlib
@@ -50,6 +72,15 @@ def _params_crc(tree) -> int:
     return crc
 
 
+def _flat_pad_np(a: np.ndarray, world: int) -> np.ndarray:
+    """Host-side mirror of ZeRO1's flatten-and-pad leaf layout."""
+    flat = np.ascontiguousarray(a).reshape(-1)
+    c = -(-flat.size // world)
+    out = np.zeros((world * c,), flat.dtype)
+    out[: flat.size] = flat
+    return out
+
+
 def child_main(argv: list[str] | None = None) -> int:
     """One rank of the drill job (rank/world/coordinator via the
     launcher's TPUDML_* env contract)."""
@@ -67,9 +98,19 @@ def child_main(argv: list[str] | None = None) -> int:
     ap.add_argument("--kill_rank", type=int, default=1)
     ap.add_argument("--kill_marker", type=str, default=None)
     ap.add_argument("--obs_dir", type=str, default=None)
+    # Engine-chain knobs: the plan fills whatever the CLI leaves unset —
+    # the same explicit-flags-win precedence core/config.py applies for
+    # the tasks' --plan wiring.
+    ap.add_argument("--plan", type=str, default=None,
+                    help="planner plan.json; its engine_config fills "
+                         "engine/accum_steps unless given explicitly")
+    ap.add_argument("--engine", type=str, default=None,
+                    choices=("dp", "zero1"))
+    ap.add_argument("--accum_steps", type=int, default=None)
     args = ap.parse_args(argv)
 
     import jax
+    import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from tpudml.checkpoint.sharded import (
@@ -83,8 +124,29 @@ def child_main(argv: list[str] | None = None) -> int:
     from tpudml.nn.losses import softmax_cross_entropy
     from tpudml.obs.tracer import Tracer, set_tracer
     from tpudml.optim.optimizers import make_optimizer
+    from tpudml.optim.zero1 import ZeRO1
     from tpudml.parallel.sharding import shard_map_fn
     from tpudml.resilience.faults import rank_kill_hook
+
+    # Plan merge, explicit CLI wins: flags left at their (None) defaults
+    # are filled from the plan's engine_config; anything given explicitly
+    # overrides the plan.
+    engine = args.engine
+    accum = args.accum_steps
+    if args.plan:
+        from tpudml.plan.emit import load_plan
+
+        ec = load_plan(args.plan)["engine_config"]
+        if engine is None:
+            engine = ec.get("engine")
+        if accum is None:
+            accum = int(ec.get("accum_steps", 1))
+    engine = engine or "dp"
+    accum = accum or 1
+    if engine not in ("dp", "zero1"):
+        raise SystemExit(
+            f"drill child implements dp/zero1 chains, got {engine!r}"
+        )
 
     distributed_init(DistributedConfig.from_env())
     rank = process_index()
@@ -94,13 +156,21 @@ def child_main(argv: list[str] | None = None) -> int:
     world = int(np.prod(mesh.devices.shape))
     if args.global_batch % world:
         raise SystemExit(f"global_batch {args.global_batch} % world {world} != 0")
+    if (args.global_batch // world) % accum:
+        raise SystemExit(
+            f"local batch {args.global_batch // world} % accum {accum} != 0"
+        )
 
     model = ForwardMLP(
         in_features=args.feature_dim, hidden=(32, 16), num_classes=args.classes
     )
     params, _ = model.init(seed_key(args.seed))
     opt = make_optimizer("sgd", args.lr, momentum=args.momentum)
-    opt_state = opt.init(params)
+    zopt = (
+        ZeRO1(base=opt, axis_name="data", world=world)
+        if engine == "zero1"
+        else None
+    )
 
     # Batches are a pure function of the step index (same on every rank and
     # every incarnation): a resumed run replays steps c..N-1 bit-exactly.
@@ -120,6 +190,7 @@ def child_main(argv: list[str] | None = None) -> int:
 
     rep = NamedSharding(mesh, P())
     row_sharded = NamedSharding(mesh, P("data"))
+    flat_sharded = NamedSharding(mesh, P("data"))
 
     def to_global(host: np.ndarray, sharding) -> jax.Array:
         return jax.make_array_from_callback(
@@ -132,12 +203,18 @@ def child_main(argv: list[str] | None = None) -> int:
         )
 
     # Resume from the newest CRC-valid sharded checkpoint, if any. The
-    # restore reassembles full host arrays from ALL processes' shards, so
-    # this works even when the writing incarnation had a different world
-    # size (the controller's "shrink" policy).
+    # checkpoint layout is CANONICAL — full-shaped params + full-shaped
+    # momentum ("mom") + step — regardless of the chain that wrote it, so
+    # any chain at any world restores any other's checkpoint (the
+    # property that makes shrink-with-chain-switch a restore, not a
+    # retrain).
+    params_host = jax.tree.map(np.asarray, params)
+    mom_host = (
+        jax.tree.map(np.zeros_like, params_host) if args.momentum else ()
+    )
     target = {
-        "opt": jax.tree.map(np.asarray, opt_state),
-        "params": jax.tree.map(np.asarray, params),
+        "mom": mom_host,
+        "params": params_host,
         "step": np.zeros((), np.int64),
     }
     restored = restore_latest_valid_sharded(args.ckpt_dir, target)
@@ -150,27 +227,82 @@ def child_main(argv: list[str] | None = None) -> int:
         )
         tracer.instant("drill_resume", cat="elastic", args={"step": start_step})
     params = replicate(restored["params"])
-    opt_state = replicate(restored["opt"])
+    if engine == "zero1":
+        # Chain-specific device layout: ZeRO-1 moments live flat-padded
+        # [N·c] and row-sharded over the data axis — the exact
+        # ZeRO1.flatten_params layout, zero-padding exact for SGD.
+        opt_state = jax.tree.map(
+            lambda a: to_global(_flat_pad_np(np.asarray(a), world), flat_sharded),
+            restored["mom"],
+        )
+    else:
+        opt_state = replicate(restored["mom"])
 
-    def step_body(params, opt_state, x, y):
-        def loss_fn(p):
-            logits, _ = model.apply(p, {}, x, train=True)
-            return softmax_cross_entropy(logits, y)
+    def loss_fn(p, xm, ym):
+        logits, _ = model.apply(p, {}, xm, train=True)
+        return softmax_cross_entropy(logits, ym)
 
-        loss, grads = jax.value_and_grad(loss_fn)(params)
-        grads = jax.tree.map(lambda g: jax.lax.pmean(g, "data"), grads)
-        loss = jax.lax.pmean(loss, "data")
-        new_params, new_opt = opt.update(grads, opt_state, params)
-        return new_params, new_opt, loss
+    def local_loss_grads(p, x, y):
+        """Gradient accumulation over ``accum`` micro-batches of the
+        local rows (mean loss, mean grads) — unrolled, deterministic."""
+        if accum == 1:
+            return jax.value_and_grad(loss_fn)(p, x, y)
+        xs = x.reshape(accum, -1, x.shape[-1])
+        ys = y.reshape(accum, -1)
+        loss, grads = jax.value_and_grad(loss_fn)(p, xs[0], ys[0])
+        for i in range(1, accum):
+            li, gi = jax.value_and_grad(loss_fn)(p, xs[i], ys[i])
+            loss = loss + li
+            grads = jax.tree.map(jnp.add, grads, gi)
+        return loss / accum, jax.tree.map(lambda g: g / accum, grads)
+
+    if engine == "zero1":
+        state_spec = P("data")
+
+        def step_body(params, opt_state, x, y):
+            loss, grads = local_loss_grads(params, x, y)
+            loss = jax.lax.pmean(loss, "data")
+            # No gradient pmean: ZeRO1.update's reduce-scatter IS the
+            # mean over the data axis (zero1_handles contract).
+            new_params, new_opt = zopt.update(grads, opt_state, params)
+            return new_params, new_opt, loss
+    else:
+        state_spec = P()
+
+        def step_body(params, opt_state, x, y):
+            loss, grads = local_loss_grads(params, x, y)
+            grads = jax.tree.map(lambda g: jax.lax.pmean(g, "data"), grads)
+            loss = jax.lax.pmean(loss, "data")
+            new_params, new_opt = opt.update(grads, opt_state, params)
+            return new_params, new_opt, loss
 
     step_fn = jax.jit(
         shard_map_fn(
             step_body,
             mesh,
-            in_specs=(P(), P(), P("data"), P("data")),
-            out_specs=(P(), P(), P()),
+            in_specs=(P(), state_spec, P("data"), P("data")),
+            out_specs=(P(), state_spec, P()),
         )
     )
+
+    if engine == "zero1":
+        tmpl = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), params_host
+        )
+        gather_mom = jax.jit(
+            shard_map_fn(
+                lambda s: zopt.gather_params(s, tmpl),
+                mesh,
+                in_specs=(state_spec,),
+                out_specs=P(),
+            )
+        )
+
+    def canonical_mom(state):
+        """The checkpointed momentum: always full parameter-shaped."""
+        if engine == "zero1" and jax.tree.leaves(state):
+            return gather_mom(state)
+        return state
 
     kill = None
     if args.kill_step >= 0:
@@ -179,6 +311,8 @@ def child_main(argv: list[str] | None = None) -> int:
         )
 
     loss = None
+    losses: list[np.float32] = []
+    t_loop = time.perf_counter()
     for step in range(start_step, args.steps):
         if kill is not None:
             kill(step=step)
@@ -188,22 +322,29 @@ def child_main(argv: list[str] | None = None) -> int:
                 params, opt_state, to_global(x, row_sharded), to_global(y, row_sharded)
             )
             jax.block_until_ready(loss)
+        losses.append(np.float32(np.asarray(loss)))
         if args.ckpt_every and (step + 1) % args.ckpt_every == 0:
             with tracer.span("drill_checkpoint", cat="ckpt", args={"step": step + 1}):
                 save_sharded_checkpoint(
                     args.ckpt_dir,
                     {
-                        "opt": opt_state,
+                        "mom": canonical_mom(opt_state),
                         "params": params,
                         "step": np.int64(step + 1),
                     },
                     step + 1,
                 )
+    wall = time.perf_counter() - t_loop
+    executed = args.steps - start_step
+    sps = executed / wall if wall > 0 else 0.0
 
     crc = _params_crc(params)
+    loss_crc = zlib.crc32(np.asarray(losses, np.float32).tobytes())
     print(
-        f"[drill] rank {rank} world {world} final_step {args.steps} "
-        f"loss {float(np.asarray(loss)):.6f} params_crc {crc:08x}",
+        f"[drill] rank {rank} world {world} engine {engine} accum {accum} "
+        f"final_step {args.steps} loss {float(np.asarray(loss)):.6f} "
+        f"params_crc {crc:08x} loss_crc {loss_crc:08x} "
+        f"steps_per_s {sps:.3f}",
         flush=True,
     )
     if args.obs_dir:
@@ -214,9 +355,10 @@ def child_main(argv: list[str] | None = None) -> int:
 
 # --------------------------------------------------------------- driver
 
-_CRC_RE = re.compile(
-    r"\[drill\] rank (\d+) world (\d+) final_step (\d+) "
-    r"loss [-0-9.einfa]+ params_crc ([0-9a-f]{8})"
+_FINAL_RE = re.compile(
+    r"\[drill\] rank (\d+) world (\d+) engine (\w+) accum (\d+) "
+    r"final_step (\d+) loss [-0-9.einfa]+ params_crc ([0-9a-f]{8}) "
+    r"loss_crc ([0-9a-f]{8}) steps_per_s ([0-9.]+)"
 )
 _RESUME_RE = re.compile(r"\[drill\] rank (\d+) resumed step (\d+) wall ([0-9.]+)")
 
@@ -235,8 +377,24 @@ class _Tee(io.TextIOBase):
             k.flush()
 
 
+def _parse_finals(log: str) -> dict[int, dict]:
+    """rank → the final-line evidence record."""
+    out = {}
+    for m in _FINAL_RE.finditer(log):
+        out[int(m.group(1))] = {
+            "world": int(m.group(2)),
+            "engine": m.group(3),
+            "accum_steps": int(m.group(4)),
+            "final_step": int(m.group(5)),
+            "params_crc": m.group(6),
+            "loss_crc": m.group(7),
+            "steps_per_s": float(m.group(8)),
+        }
+    return out
+
+
 def _parse_crcs(log: str) -> dict[int, str]:
-    return {int(m.group(1)): m.group(4) for m in _CRC_RE.finditer(log)}
+    return {r: f["params_crc"] for r, f in _parse_finals(log).items()}
 
 
 def _parse_resumes(log: str) -> list[tuple[int, int, float]]:
@@ -259,7 +417,7 @@ def run_drill(
     seed: int = 0,
     sink=None,
 ) -> dict:
-    """Run the full drill; returns the MTTR/bit-exactness evidence dict.
+    """Run the full restart drill; returns the MTTR/bit-exactness evidence.
 
     Sequence: (1) uninterrupted ``world``-process run → reference CRC;
     (2) same job with rank ``kill_rank`` hard-killed at ``kill_step``,
@@ -319,6 +477,10 @@ def run_drill(
     eres = ctrl.run()
     drill_crcs = _parse_crcs(drill_log.getvalue())
     resumes = _parse_resumes(drill_log.getvalue())
+    obs_dir.mkdir(parents=True, exist_ok=True)
+    (obs_dir / "elastic.json").write_text(
+        json.dumps(eres.to_dict(), indent=2, sort_keys=True) + "\n"
+    )
 
     # (3) per-process trace evidence: the final (successful) incarnation's
     # ranks each exported their own pid track.
@@ -391,6 +553,272 @@ def run_drill(
             else None
         ),
         "params_crc": next(iter(clean_crcs.values()), None),
+        "trace_pids": pids,
+    }
+
+
+def _copy_step(src_ckpt: Path, step: int, dst_ckpt: Path) -> None:
+    """Copy one ``step_{k}`` checkpoint dir — the pristine restore point
+    the reference arms start from (the drill's own dir keeps growing past
+    it as the continuation writes newer steps)."""
+    src = src_ckpt / f"step_{step}"
+    dst = dst_ckpt / f"step_{step}"
+    dst_ckpt.mkdir(parents=True, exist_ok=True)
+    shutil.copytree(src, dst)
+
+
+def run_shrink_drill(
+    base_dir: str,
+    *,
+    world: int = 2,
+    steps: int = 20,
+    ckpt_every: int = 5,
+    kill_step: int = 13,
+    kill_rank: int = 1,
+    backoff_s: float = 0.25,
+    timeout_s: float = 600.0,
+    seed: int = 0,
+    include_naive: bool = False,
+    sink=None,
+) -> dict:
+    """The shrink-re-plan drill: SIGKILL → planner consulted at the new
+    world → resume under a *different* engine chain → bit-exact.
+
+    Sequence:
+
+    1. Plan the launch config: :class:`Replanner` over the dp/zero1
+       lattice at ``world`` (winner: ZeRO-1 + accumulation) writes
+       ``plan.json``; the child picks the chain up via ``--plan``.
+    2. Drill run under :class:`ElasticController` (``shrink`` policy,
+       replanner attached): rank ``kill_rank`` is hard-killed at
+       ``kill_step``; the controller shrinks to ``world-1``, consults
+       the planner (at world 1 ZeRO-1 is infeasible — receipts say so —
+       and plain DP wins), rewrites ``plan.json``, and re-forms; the
+       new incarnation restores the canonical checkpoint under the new
+       chain and finishes.
+    3. Reference arm: an uninterrupted ``world-1`` run of the *new*
+       chain started from a pristine copy of the same checkpoint — the
+       continued run must match it bit-exactly (params CRC and
+       loss-history CRC).
+    4. Optional naive arm (``include_naive``): the *old* chain forced at
+       ``world-1`` via explicit ``--engine``/``--accum_steps`` flags
+       (which override the plan — the precedence contract), so
+       re-planned-vs-naive throughput is measured, not claimed.
+    """
+    from tpudml.elastic.controller import ElasticController
+    from tpudml.elastic.replan import Replanner
+    from tpudml.launch.cluster import ClusterSpec
+    from tpudml.launch.launcher import launch
+    from tpudml.obs.tracer import (
+        Tracer,
+        merge_chrome_traces,
+        set_tracer,
+        validate_chrome_trace,
+    )
+    from tpudml.plan.space import flagship_lm
+
+    base = Path(base_dir)
+    base.mkdir(parents=True, exist_ok=True)
+    obs_dir = base / "obs"
+    obs_dir.mkdir(parents=True, exist_ok=True)
+    plan_path = base / "plan.json"
+    ckpt_dir = base / "drill_ckpt"
+
+    # Controller-side tracer: the reform/replan instants land in their
+    # own exported track (the children export theirs per-rank).
+    tracer = Tracer()
+    set_tracer(tracer)
+
+    # (1) plan the launch. dp/zero1 lattice: at world>=2 the planner
+    # picks ZeRO-1 (+accum, overlap hidden); at world 1 ZeRO-1 has no
+    # mesh, so a shrink forces a genuine chain switch.
+    rp = Replanner(
+        flagship_lm(),
+        engines=["dp", "zero1"],
+        verify=False,
+        plan_path=plan_path,
+    )
+    old_plan = rp.initial_plan(world)
+    old_key = old_plan["winner"]["candidate"]["key"]
+    old_engine = old_plan["engine_config"]["engine"]
+    old_accum = old_plan["engine_config"]["accum_steps"]
+
+    child = [
+        sys.executable, "-u", "-m", "tpudml.elastic.drill",
+        "--steps", str(steps),
+        "--ckpt_every", str(ckpt_every),
+        "--seed", str(seed),
+        "--plan", str(plan_path),
+    ]
+    spec = ClusterSpec(num_processes=world, timeout_s=timeout_s, grace_s=3.0)
+
+    # (2) the drill: shrink policy + replanner.
+    marker = base / "kill.marker"
+    drill_cmd = child + [
+        "--ckpt_dir", str(ckpt_dir),
+        "--obs_dir", str(obs_dir),
+        "--kill_step", str(kill_step),
+        "--kill_rank", str(kill_rank),
+        "--kill_marker", str(marker),
+    ]
+    drill_log = io.StringIO()
+    ctrl = ElasticController(
+        drill_cmd,
+        dataclasses.replace(
+            spec,
+            restart_backoff_s=backoff_s,
+            restart_backoff_jitter=0.5,
+            restart_backoff_seed=seed,
+        ),
+        policy="shrink",
+        min_world=1,
+        max_reforms=2,
+        replanner=rp,
+        sink=_Tee(drill_log, sink),
+    )
+    eres = ctrl.run()
+    finals = _parse_finals(drill_log.getvalue())
+    resumes = _parse_resumes(drill_log.getvalue())
+    new_plan = rp.plan
+    new_key = new_plan["winner"]["candidate"]["key"]
+    new_engine = new_plan["engine_config"]["engine"]
+    new_accum = new_plan["engine_config"]["accum_steps"]
+    replan = eres.replans[0] if eres.replans else None
+    (obs_dir / "elastic.json").write_text(
+        json.dumps(eres.to_dict(), indent=2, sort_keys=True) + "\n"
+    )
+    tracer.export(obs_dir / "trace_controller.json")
+
+    resume_step = min((s for _, s, _ in resumes), default=None)
+    steps_lost = kill_step - resume_step if resume_step is not None else None
+    restart_latency_s = (
+        max(w for _, _, w in resumes) - eres.records[0].t_end
+        if resumes and len(eres.records) >= 2
+        else None
+    )
+    final = finals.get(0)
+
+    # (3) the reference arm: new chain, same checkpoint, uninterrupted.
+    bit_exact = False
+    ref_final = None
+    if resume_step is not None and final is not None:
+        _copy_step(ckpt_dir, resume_step, base / "ref_ckpt")
+        ref_log = io.StringIO()
+        ref = launch(
+            child + ["--ckpt_dir", str(base / "ref_ckpt")],
+            dataclasses.replace(spec, num_processes=world - 1),
+            sink=_Tee(ref_log, sink),
+        )
+        ref_final = _parse_finals(ref_log.getvalue()).get(0)
+        bit_exact = (
+            ref.success
+            and ref_final is not None
+            and ref_final["params_crc"] == final["params_crc"]
+            and ref_final["loss_crc"] == final["loss_crc"]
+        )
+
+    # (4) the naive A/B arm: old chain forced at the shrunken world by
+    # explicit flags (explicit CLI beats the plan file).
+    naive = None
+    replan_beats_naive = None
+    if include_naive and resume_step is not None and final is not None:
+        _copy_step(ckpt_dir, resume_step, base / "naive_ckpt")
+        naive_log = io.StringIO()
+        naive_res = launch(
+            child + [
+                "--ckpt_dir", str(base / "naive_ckpt"),
+                "--engine", str(old_engine),
+                "--accum_steps", str(old_accum),
+            ],
+            dataclasses.replace(spec, num_processes=world - 1),
+            sink=_Tee(naive_log, sink),
+        )
+        naive_final = _parse_finals(naive_log.getvalue()).get(0)
+        if naive_res.success and naive_final is not None:
+            naive = {
+                "engine": naive_final["engine"],
+                "accum_steps": naive_final["accum_steps"],
+                "steps_per_s": naive_final["steps_per_s"],
+                "params_crc": naive_final["params_crc"],
+            }
+            replan_beats_naive = (
+                final["steps_per_s"] > naive_final["steps_per_s"]
+            )
+
+    # Trace evidence: the surviving incarnation's rank 0 track merges.
+    pids: list[int] = []
+    trace_files = sorted(obs_dir.glob("trace_p*.json"))
+    if trace_files:
+        try:
+            merged = merge_chrome_traces(
+                [json.loads(p.read_text()) for p in trace_files]
+            )
+            validate_chrome_trace(merged)
+            (obs_dir / "trace.json").write_text(
+                json.dumps(merged, sort_keys=True, separators=(",", ":")) + "\n"
+            )
+            pids = sorted(
+                {e["pid"] for e in merged["traceEvents"] if e["ph"] != "M"}
+            )
+        except ValueError:
+            pids = []
+
+    ports = [r.coordinator_port for r in eres.records]
+    receipts = list(replan["receipts"]) if replan else []
+    plan_switched = bool(replan and replan.get("switched") and not replan.get("error"))
+    chain_switched = (
+        final is not None
+        and final["engine"] == new_engine
+        and new_engine != old_engine
+    )
+    ok = (
+        eres.success
+        and eres.reforms == 1
+        and eres.final_world == world - 1
+        and plan_switched
+        and chain_switched
+        and bool(receipts)
+        and resume_step is not None
+        and steps_lost is not None
+        and steps_lost >= 0
+        and bit_exact
+        and len(set(ports)) == len(ports)
+    )
+    return {
+        "ok": ok,
+        "mode": "shrink_replan",
+        "bit_exact": bit_exact,
+        "world": world,
+        "final_world": eres.final_world,
+        "steps": steps,
+        "kill_step": kill_step,
+        "kill_rank": kill_rank,
+        "killed_rank_observed": eres.records[0].failed_rank
+        if eres.records
+        else None,
+        "resume_step": resume_step,
+        "steps_lost": steps_lost,
+        "reforms": eres.reforms,
+        "coordinator_ports": ports,
+        "fresh_port": len(set(ports)) == len(ports),
+        "backoff_s": eres.records[-1].backoff_s if eres.reforms else 0.0,
+        "restart_latency_s": restart_latency_s,
+        "drill_wall_s": eres.total_elapsed_s,
+        "old_plan": {
+            "key": old_key, "engine": old_engine, "accum_steps": old_accum,
+        },
+        "new_plan": {
+            "key": new_key, "engine": new_engine, "accum_steps": new_accum,
+        },
+        "plan_switched": plan_switched,
+        "chain_switched": chain_switched,
+        "replan_latency_s": replan["latency_s"] if replan else None,
+        "replan_receipts": receipts,
+        "params_crc": final["params_crc"] if final else None,
+        "loss_crc": final["loss_crc"] if final else None,
+        "post_shrink_steps_per_s": final["steps_per_s"] if final else None,
+        "naive": naive,
+        "replan_beats_naive": replan_beats_naive,
         "trace_pids": pids,
     }
 

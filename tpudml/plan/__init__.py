@@ -17,12 +17,20 @@ and a chip count it
    (``tpudml.comm.timing.collective_wire_bytes``) plus a roofline
    step-time estimate (compute FLOPs vs MXU, memory traffic vs HBM,
    exposed comm after overlap attribution);
-4. **emits** the winner (``emit.py``) as a runnable ``plan.json`` (v1
-   schema) — and self-verifies it first: the winning engine is built on
-   the dryrun mesh, traced, and run through the J112–J116 dataflow rules;
-   a plan that would lose a psum or blow the HBM budget is rejected
-   before it ever runs, and the traced comm/HBM land in the plan's
-   ``predicted`` block, which rule J118 later holds the code to.
+4. **emits** the winner (``emit.py``) as a runnable ``plan.json`` (v2
+   schema; v1 files still load) — and self-verifies it first: the
+   winning engine is built on the dryrun mesh, traced, and run through
+   the J112–J116 dataflow rules; a plan that would lose a psum or blow
+   the HBM budget is rejected before it ever runs, and the traced
+   comm/HBM land in the plan's ``predicted`` block, which rule J118
+   later holds the code to.
+
+Since PR 16 the planner is also a *runtime* controller: on an elastic
+membership change ``tpudml.elastic.replan.Replanner`` re-runs this
+pipeline at the new world size (recording receipts for why the old
+config lost), and a J118/drift firing re-scores the lattice with the
+measured constants folded in as a :class:`~tpudml.plan.score.Calibration`
+— both land in the plan's v2 ``replan`` / ``calibration`` blocks.
 
 CLI: ``python -m tpudml.plan`` (``--format text|json|github``,
 ``--check`` for the world-4/8 smoke).  Validation the other way:
@@ -32,6 +40,7 @@ planner's top-1 within tolerance of the measured best.
 
 from tpudml.plan.emit import (
     PLAN_VERSION,
+    SUPPORTED_PLAN_VERSIONS,
     build_candidate,
     load_plan,
     make_plan,
@@ -40,7 +49,7 @@ from tpudml.plan.emit import (
     verify_candidate,
 )
 from tpudml.plan.prune import PruneRecord, prune
-from tpudml.plan.score import Hardware, Score, score_candidate
+from tpudml.plan.score import Calibration, Hardware, Score, score_candidate
 from tpudml.plan.space import (
     Candidate,
     ModelSpec,
@@ -50,6 +59,8 @@ from tpudml.plan.space import (
 
 __all__ = [
     "PLAN_VERSION",
+    "SUPPORTED_PLAN_VERSIONS",
+    "Calibration",
     "Candidate",
     "Hardware",
     "ModelSpec",

@@ -1,5 +1,5 @@
 """Plan emission: rank the survivors, build + trace + verify the
-winner, serialize the runnable ``plan.json`` (v1 schema).
+winner, serialize the runnable ``plan.json`` (v2 schema).
 
 ``make_plan`` is the whole pipeline.  Self-verification is the
 load-bearing part: the winning candidate is constructed as a *real*
@@ -13,11 +13,11 @@ peak-live HBM of the winner), which is the contract rule J118 later
 holds the code to: re-trace the entrypoint, compare against
 ``predicted``, flag >10% drift.
 
-plan.json v1 schema (all byte-deterministic — no timestamps, sorted
+plan.json v2 schema (all byte-deterministic — no timestamps, sorted
 keys)::
 
     {
-      "version": 1,
+      "version": 2,
       "world": int,
       "spec": ModelSpec.to_dict(),
       "hbm_budget_bytes": int | null,
@@ -27,8 +27,20 @@ keys)::
       "pruned": [{"candidate", "rule", "reason"}, ...],  # every drop, with why
       "predicted": {"comm_wire_bytes": float, "peak_hbm_bytes": int},
       "verification": {"entrypoint", "ok", "findings": [...],
-                       "demoted": [...]}                 # winners that failed
+                       "demoted": [...]},                # winners that failed
+      "calibration": null | Calibration.to_dict(),       # measured constants
+      "replan": null | {"trigger", "why", "old_world",   # re-plan provenance
+                        "old_winner", "receipts": [...]}
     }
+
+v2 adds two always-present keys over v1 (schema totality keeps the
+byte-determinism pin trivial): ``calibration`` — the measured scales a
+drift-triggered re-score folded into the roofline (null for a plan
+scored on the nominal constants) — and ``replan`` — the provenance of
+an adaptive re-plan (what triggered it, what the previous winner was,
+and the machine-readable receipts for why it lost), null for a plan
+made fresh.  ``load_plan`` still reads v1 files, upgrading them
+in-memory with both keys null.
 """
 
 from __future__ import annotations
@@ -40,7 +52,10 @@ from tpudml.plan.prune import prune
 from tpudml.plan.score import PP_MICROBATCHES, score_candidate
 from tpudml.plan.space import Candidate, ModelSpec, enumerate_candidates
 
-PLAN_VERSION = 1
+PLAN_VERSION = 2
+
+#: Versions ``load_plan`` accepts; older ones are upgraded in-memory.
+SUPPORTED_PLAN_VERSIONS = (1, 2)
 
 
 def _mesh(axes: dict, world: int):
@@ -273,8 +288,18 @@ def make_plan(
     hbm_budget_bytes: int | None = None,
     engines=None,
     verify: bool = True,
+    calibration=None,
+    replan: dict | None = None,
 ) -> dict:
-    """enumerate → prune → score → verify-the-winner → plan dict."""
+    """enumerate → prune → score → verify-the-winner → plan dict.
+
+    ``calibration`` (a :class:`tpudml.plan.score.Calibration`) re-scores
+    the lattice with measured constants — the drift-triggered re-plan
+    path; ``replan`` is the provenance record an adaptive re-plan stamps
+    (trigger + old winner + receipts), recorded verbatim.  Both default
+    to None, which is what the corresponding plan keys serialize as for
+    a fresh plan.
+    """
     cands = enumerate_candidates(world, engines=engines)
     survivors, dropped = prune(spec, cands, hbm_budget_bytes)
     if not survivors:
@@ -282,7 +307,10 @@ def make_plan(
             f"no feasible candidate at world {world}: all "
             f"{len(cands)} pruned"
         )
-    scored = [(score_candidate(spec, c), c) for c in survivors]
+    scored = [
+        (score_candidate(spec, c, calibration=calibration), c)
+        for c in survivors
+    ]
     scored.sort(key=lambda sc: (sc[0].per_token_s, sc[1].key()))
 
     demoted = []
@@ -332,6 +360,10 @@ def make_plan(
         "pruned": [r.to_dict() for r in dropped],
         "predicted": predicted,
         "verification": verification,
+        "calibration": (
+            calibration.to_dict() if calibration is not None else None
+        ),
+        "replan": replan,
     }
 
 
@@ -358,11 +390,21 @@ def plan_to_json(plan: dict) -> str:
 
 
 def load_plan(path: str) -> dict:
+    """Read a plan.json, accepting every supported schema version.
+
+    v1 files (pre-calibration) are upgraded in-memory: the v2-only keys
+    are filled with their fresh-plan null values so downstream readers
+    can rely on the total v2 schema. The on-disk file is never touched.
+    """
     with open(path) as fh:
         plan = json.load(fh)
     ver = plan.get("version")
-    if ver != PLAN_VERSION:
+    if ver not in SUPPORTED_PLAN_VERSIONS:
         raise ValueError(
-            f"{path}: plan version {ver!r} != supported {PLAN_VERSION}"
+            f"{path}: plan version {ver!r} not in supported "
+            f"{SUPPORTED_PLAN_VERSIONS}"
         )
+    if ver < PLAN_VERSION:
+        plan.setdefault("calibration", None)
+        plan.setdefault("replan", None)
     return plan

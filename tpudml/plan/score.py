@@ -64,6 +64,61 @@ DEFAULT_HARDWARE = Hardware()
 
 
 @dataclass(frozen=True)
+class Calibration:
+    """Measured correction factors folded into the roofline terms.
+
+    The self-calibrating half of the planner loop: when the drift
+    monitor (``obs/drift.py`` / rule J118) observes measured comm or
+    HBM deviating from the static model past the shared threshold, the
+    re-plan re-scores the lattice with these scales applied — the cost
+    model learns the constant it was wrong by instead of ranking with
+    it forever.  ``basis`` keeps the drift records the scales were
+    fitted from, so a plan's ``calibration`` block is auditable.
+    """
+
+    comm_scale: float = 1.0
+    hbm_scale: float = 1.0
+    source: str = "default"
+    basis: tuple = ()  # tuple of drift-record dicts (sorted-key frozen)
+
+    @classmethod
+    def from_drift_records(cls, records, source: str = "obs/drift") -> "Calibration":
+        """Fit ``comm_scale`` as the wire-byte-weighted measured/static
+        ratio over the drift records — the single multiplicative
+        constant that would zero the aggregate drift."""
+        static = sum(float(r["static_wire_bytes"]) for r in records)
+        measured = sum(float(r["measured_wire_bytes"]) for r in records)
+        scale = measured / static if static > 0 else 1.0
+        basis = tuple(
+            {
+                "entrypoint": r["entrypoint"],
+                "static_wire_bytes": float(r["static_wire_bytes"]),
+                "measured_wire_bytes": float(r["measured_wire_bytes"]),
+                "rel_err": float(r["rel_err"]),
+            }
+            for r in records
+        )
+        return cls(comm_scale=scale, source=source, basis=basis)
+
+    def to_dict(self) -> dict:
+        return {
+            "comm_scale": self.comm_scale,
+            "hbm_scale": self.hbm_scale,
+            "source": self.source,
+            "basis": [dict(b) for b in self.basis],
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Calibration":
+        return cls(
+            comm_scale=d["comm_scale"],
+            hbm_scale=d["hbm_scale"],
+            source=d["source"],
+            basis=tuple(d.get("basis", ())),
+        )
+
+
+@dataclass(frozen=True)
 class Score:
     """Priced candidate: the ranked table row and plan.json record."""
 
@@ -137,7 +192,10 @@ def _world(cand: Candidate) -> int:
 
 
 def score_candidate(
-    spec: ModelSpec, cand: Candidate, hw: Hardware = DEFAULT_HARDWARE
+    spec: ModelSpec,
+    cand: Candidate,
+    hw: Hardware = DEFAULT_HARDWARE,
+    calibration: Calibration | None = None,
 ) -> Score:
     data, model, stage = _axes(cand)
     world = _world(cand)
@@ -202,8 +260,10 @@ def score_candidate(
             # vocab-sharded head: online lse-merge statistics, [B_dev, T]
             stats = 3 * (rows // data) * spec.seq_len * spec.dtype_bytes
             exposed += collective_wire_bytes("psum", stats, model)
-    exposed_s = exposed / hw.ici_bytes_per_s
-    hidden_s = hidden / hw.ici_bytes_per_s
+    comm_scale = calibration.comm_scale if calibration is not None else 1.0
+    hbm_scale = calibration.hbm_scale if calibration is not None else 1.0
+    exposed_s = exposed * comm_scale / hw.ici_bytes_per_s
+    hidden_s = hidden * comm_scale / hw.ici_bytes_per_s
 
     step = max(compute_s, memory_s) + exposed_s
     if cand.sentinel:
@@ -216,7 +276,7 @@ def score_candidate(
         memory_s=memory_s,
         exposed_comm_s=exposed_s,
         hidden_comm_s=hidden_s,
-        comm_wire_bytes=exposed + hidden,
-        est_hbm_bytes=estimate_hbm(spec, cand),
+        comm_wire_bytes=(exposed + hidden) * comm_scale,
+        est_hbm_bytes=int(estimate_hbm(spec, cand) * hbm_scale),
         tokens_per_step=tokens,
     )

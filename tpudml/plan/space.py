@@ -141,11 +141,22 @@ def _two_axis(world: int) -> list:
 
 
 def _engine_meshes(engine: str, world: int) -> list:
-    """Mesh shapes an engine chain can occupy at ``world`` chips."""
-    if engine in ("dp", "zero1", "fsdp"):
+    """Mesh shapes an engine chain can occupy at ``world`` chips.
+
+    At ``world == 1`` only plain DP is enumerable: every other chain
+    exists to shard something across chips (ZeRO-1/FSDP shard state
+    over data, TP shards features, PP shards layers) and degenerates
+    to DP-with-extra-collectives on a single chip — the planner's
+    answer there is an *empty* mesh list, which the re-plan path turns
+    into an honest "infeasible at world 1" receipt rather than a
+    silently-degenerate candidate.
+    """
+    if engine == "dp":
         return [(("data", world),)]
+    if engine in ("zero1", "fsdp"):
+        return [(("data", world),)] if world >= 2 else []
     if engine == "tp":
-        return [(("model", world),)]
+        return [(("model", world),)] if world >= 2 else []
     if engine == "fsdp_tp":
         return [
             (("data", a), ("model", b)) for a, b in _two_axis(world)
@@ -168,8 +179,8 @@ def enumerate_candidates(
     candidate report demonstrates the shared rejection rules firing
     rather than silently never generating the combination.
     """
-    if world < 2:
-        raise ValueError(f"world must be >= 2, got {world}")
+    if world < 1:
+        raise ValueError(f"world must be >= 1, got {world}")
     out = []
     for engine in engines if engines is not None else ENGINES:
         if engine not in ENGINES:
