@@ -1296,6 +1296,113 @@ def main_serve() -> None:
     print(json.dumps(bench_serve(on_tpu, smoke="--smoke" in sys.argv[1:])))
 
 
+def bench_fleet(on_tpu, smoke=False) -> dict:
+    """Serving-fleet row (ROADMAP item 3's success metric): aggregate
+    tokens/s and ttft/tpot p50/p99 across N replicas at 2×-overload,
+    with one replica killed mid-run and re-formed — the kill arm's tail
+    latencies must HOLD against the no-kill arm, which is the whole
+    point of drain/re-admit (a dead replica costs re-prefill work, not
+    correctness or fairness). A third arm quantizes replica weights to
+    int8 to show the DecodeCostModel pricing the smaller param-byte
+    term (placement honesty, serve/sched.py).
+
+    Deterministic by construction: the fleet runs on the virtual clock,
+    so every number here is a pure function of (seed, config) — the
+    CPU-dryrun caveat applies to the roofline CONSTANTS, not the
+    scheduling."""
+    from tpudml.models.transformer import TransformerLM
+    from tpudml.serve.engine import ServeConfig
+    from tpudml.serve.fleet import FleetConfig, FleetRouter
+    from tpudml.serve.load import poisson_workload
+    from tpudml.serve.sched import DecodeCostModel, SLOConfig
+
+    model = TransformerLM(
+        vocab_size=64, embed_dim=32, num_heads=4, num_kv_heads=2,
+        num_layers=2, max_len=64,
+    )
+    params = model.init(jax.random.PRNGKey(0))[0]
+    replicas, slots, step_time = 3, 2, 0.01
+    n = 12 if smoke else 48
+    # Capacity ≈ replicas × slots tokens per step = 600 tok/s; at ~6
+    # tokens/request that serves ~100 req/s — offer 2× that.
+    qps = 200.0
+    requests, ledger = poisson_workload(
+        n, qps, 17, vocab_size=64, prompt_len=(4, 10), new_tokens=(4, 8),
+    )
+    slo = SLOConfig(tpot_budget_s=0.5)
+
+    def fleet_cfg(weight_quant=None):
+        return FleetConfig(
+            engine=ServeConfig(
+                slots=slots, max_len=64, prefill_chunk=8,
+                step_time_s=step_time, deadline_s=2.0, slo=slo,
+                weight_quant=weight_quant,
+            ),
+            replicas=replicas, max_queue=2 * n,
+            reform_after_steps=6,
+        )
+
+    def arm(cfg, kills):
+        rep = FleetRouter(model, params, cfg).run(requests, kills=kills)
+        lat = rep.latency_summary()
+        return {
+            "replicas": rep.replicas,
+            "steps": rep.steps,
+            "tokens_per_sec": rep.tokens_per_sec,
+            "generated_tokens": rep.generated_tokens,
+            "finished": rep.finished,
+            "rejected": rep.rejected,
+            "expired": rep.expired,
+            "kills": rep.kills,
+            "drains": rep.drains,
+            "readmits": sum(s.readmits for s in rep.requests.values()),
+            "peak_queue_depth": rep.peak_queue_depth,
+            "events_crc32": rep.events_crc32(),
+            "ttft_p50_s": lat["ttft_p50_s"],
+            "ttft_p99_s": lat["ttft_p99_s"],
+            "tpot_p50_s": lat["per_token_p50_s"],
+            "tpot_p99_s": lat["per_token_p99_s"],
+        }
+
+    kill_step = 6 if smoke else 12
+    no_kill = arm(fleet_cfg(), [])
+    kill = arm(fleet_cfg(), [(kill_step, 1)])
+    int8_arm = arm(fleet_cfg(weight_quant="int8"), [(kill_step, 1)])
+    cm_f32 = DecodeCostModel(model, fleet_cfg().engine, slo)
+    cm_int8 = DecodeCostModel(
+        model, fleet_cfg(weight_quant="int8").engine, slo
+    )
+    return {
+        "bench": "fleet",
+        "on_tpu": bool(on_tpu),
+        "smoke": bool(smoke),
+        "overload_x": 2.0,
+        "requests": n,
+        "no_kill": no_kill,
+        "kill": kill,
+        "int8_kill": int8_arm,
+        "tpot_p99_kill_over_no_kill": (
+            kill["tpot_p99_s"] / max(no_kill["tpot_p99_s"], 1e-12)
+        ),
+        "cost_params_bytes": {
+            "f32": cm_f32.params_bytes,
+            "int8": cm_int8.params_bytes,
+            "ratio": cm_f32.params_bytes / max(cm_int8.params_bytes, 1),
+        },
+    }
+
+
+def main_fleet() -> None:
+    """Driver for ``python bench.py --fleet``: prints ONE JSON line, same
+    contract as ``main()``, for the serving-fleet row (N replicas at
+    2×-overload with a mid-run replica kill). ``--smoke`` shrinks the
+    workload to the wiring-check size."""
+    import sys
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    print(json.dumps(bench_fleet(on_tpu, smoke="--smoke" in sys.argv[1:])))
+
+
 def main_zero1() -> None:
     """Driver for ``python bench.py --zero1``: prints ONE JSON line, same
     contract as ``main()`` but for the ZeRO-1 comparison. Self-provisions
@@ -1380,6 +1487,8 @@ if __name__ == "__main__":
         main_moe()
     elif "--serve" in sys.argv[1:]:
         main_serve()
+    elif "--fleet" in sys.argv[1:]:
+        main_fleet()
     elif "--sentinel" in sys.argv[1:]:
         main_sentinel()
     elif "--obs" in sys.argv[1:]:

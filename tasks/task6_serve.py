@@ -13,9 +13,11 @@ tensor-parallel mesh (reuses the training TP rules — a TP checkpoint
 serves unmodified), ``--qps inf`` for the saturation (closed-queue)
 regime. Multi-tenant levers: ``--paged`` (+ ``--page_size``,
 ``--prefix_sharing``) for the page-pool cache layout, ``--spec_k K``
-(+ ``--draft_layers``) for trunk-draft speculative decoding, and
-``--slo_tpot_ms`` for cost-model-priced admission. TP composes with
-dense only — paged/spec under ``--tp`` raise ServeCompositionError by
+(+ ``--draft_layers``) for trunk-draft speculative decoding,
+``--slo_tpot_ms`` for cost-model-priced admission, and
+``--weight_quant int8`` for per-channel int8 decode weights
+(serve.fleet.quant). TP composes with dense f32 weights only —
+paged/spec/weight_quant under ``--tp`` raise ServeCompositionError by
 contract.
 
 Reports generated tokens/sec and p50/p99 per-token, time-to-first-token,
@@ -72,6 +74,11 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="trunk-draft depth (default: num_layers // 2)")
     p.add_argument("--slo_tpot_ms", type=float, default=None,
                    help="per-token budget for SLO-priced admission")
+    p.add_argument("--weight_quant", choices=("int8", "int8_sim"),
+                   default=None,
+                   help="int8 per-channel weight quantization "
+                        "(serve.fleet.quant); int8_sim = f32-storage "
+                        "oracle. Dense only — raises under --tp.")
     # workload
     p.add_argument("--n_requests", type=int, default=16)
     p.add_argument("--qps", type=str, default="4",
@@ -114,7 +121,7 @@ def build_engine(args) -> ServingEngine:
         cache_layout="paged" if args.paged else "dense",
         page_size=args.page_size, num_pages=args.num_pages,
         prefix_sharing=args.prefix_sharing, spec_k=args.spec_k, slo=slo,
-        step_time_s=args.step_time_s,
+        step_time_s=args.step_time_s, weight_quant=args.weight_quant,
     )
     if args.tp:
         from tpudml.core.config import MeshConfig
@@ -183,6 +190,7 @@ def run(args) -> dict:
         "/tp" + str(args.tp) if args.tp else "",
         "/paged" if args.paged else "",
         f"/spec{args.spec_k}" if args.spec_k else "",
+        f"/w{args.weight_quant}" if args.weight_quant else "",
     ])
     print(
         f"[serve{mode}/{args.cache_kind}] {args.n_requests} requests @ "

@@ -1,0 +1,299 @@
+"""Serving-fleet router contract (tpudml.serve.fleet.router).
+
+Load-bearing properties: the fleet event log is BYTE-deterministic (a
+committed golden pins the serialization, two runs re-serialize
+identically), replica death conserves tokens exactly (drain → re-queue
+as continuations → re-admit elsewhere; a finished request has precisely
+its owed token count and — greedy decode being a pure function of the
+prompt — the SAME tokens an uninterrupted run produces), the committed
+CI fixtures replay meshless, and the composition/validation guards
+reject the shapes the router cannot honestly serve. The spawned drill
+(real processes, real SIGKILL, ElasticController supervision) is the
+``slow``-marked e2e at the bottom.
+"""
+
+import json
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from tpudml.models import TransformerLM
+from tpudml.serve import ServeCompositionError, ServeConfig, poisson_workload
+from tpudml.serve.fleet import (
+    FLEET_FIXTURE_VERSION,
+    FleetConfig,
+    FleetRouter,
+    replay_fleet_fixture,
+)
+
+FIXTURES = Path(__file__).parent / "fleet_fixtures"
+V = 48
+
+
+def _model():
+    return TransformerLM(vocab_size=V, embed_dim=32, num_heads=4,
+                         num_kv_heads=2, num_layers=2, max_len=64)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    model = _model()
+    params, _ = model.init(jax.random.key(0))
+    return model, params
+
+
+def _ecfg(**kw):
+    base = dict(slots=2, max_len=64, prefill_chunk=8, step_time_s=0.01)
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+def _workload(n, qps, seed):
+    requests, _ = poisson_workload(
+        n, qps, seed, vocab_size=V, prompt_len=(4, 10), new_tokens=(4, 8),
+    )
+    return requests
+
+
+# ------------------------------------------------- byte determinism
+
+
+def test_golden_event_log_bytes(setup):
+    """The steady fixture's event log re-serializes byte-for-byte
+    against the committed golden — THE fleet determinism contract."""
+    model, params = setup
+    fixture = json.loads((FIXTURES / "steady.json").read_text())
+    w = fixture["workload"]
+    requests = _workload(w["n"], w["qps"], w["seed"])
+    f = fixture["fleet"]
+    cfg = FleetConfig(engine=ServeConfig(**f["engine"]),
+                      replicas=f["replicas"], max_queue=f["max_queue"])
+    report = FleetRouter(model, params, cfg).run(requests)
+    golden = (FIXTURES / "golden_steady_events.json").read_text()
+    assert report.canonical_events() == golden
+
+
+def test_run_twice_byte_identical(setup):
+    model, params = setup
+    requests = _workload(12, 200.0, 7)
+    cfg = FleetConfig(engine=_ecfg(), replicas=2, reform_after_steps=4)
+
+    def go():
+        rep = FleetRouter(model, params, cfg).run(
+            requests, kills=[(4, 0)]
+        )
+        return rep.canonical_events(), {
+            rid: list(st.tokens) for rid, st in rep.requests.items()
+        }
+
+    ev1, tok1 = go()
+    ev2, tok2 = go()
+    assert ev1 == ev2
+    assert tok1 == tok2
+
+
+# -------------------------------------------- drain/re-admit accounting
+
+
+def test_drain_readmit_exact_accounting(setup):
+    """A mid-run kill changes WHERE requests run, never their tokens:
+    every request still finishes with exactly its owed count, and the
+    per-request token streams equal the uninterrupted run's byte-for-
+    byte (greedy decode is a pure function of the prompt, and the
+    continuation re-prefills the identical prefix)."""
+    model, params = setup
+    requests = _workload(12, 200.0, 7)
+    owed = {r.rid: r.max_new_tokens for r in requests}
+
+    base_cfg = FleetConfig(engine=_ecfg(), replicas=2)
+    clean = FleetRouter(model, params, base_cfg).run(requests)
+    assert clean.finished == len(requests)
+
+    cfg = FleetConfig(engine=_ecfg(), replicas=2, reform_after_steps=4)
+    rep = FleetRouter(model, params, cfg).run(requests, kills=[(4, 0)])
+    assert rep.kills == 1
+    assert rep.drains >= 1
+    assert rep.finished == len(requests)
+    readmitted = [st for st in rep.requests.values() if st.readmits]
+    assert readmitted, "the kill must have drained someone mid-flight"
+    for rid, st in rep.requests.items():
+        assert len(st.tokens) == owed[rid], rid
+        assert st.tokens == clean.requests[rid].tokens, rid
+    # Σ tokens conserved across the drain.
+    assert rep.generated_tokens == sum(owed.values())
+    # The drained requests really were re-placed: a second admit means a
+    # second replicas_visited entry (possibly the SAME index if the
+    # re-formed incarnation won the pricing — identity, not instance).
+    for st in readmitted:
+        assert len(st.replicas_visited) >= 2
+
+
+def test_drained_request_keeps_original_deadline(setup):
+    """Continuations expire against the ORIGINAL arrival (PR 9
+    semantics) — a kill must not grant the victim a fresh deadline."""
+    model, params = setup
+    requests = _workload(6, 300.0, 5)
+    # Deadline so tight the re-queued continuation cannot finish: the
+    # re-admitted request must EXPIRE, not finish late.
+    cfg = FleetConfig(
+        engine=_ecfg(deadline_s=0.06, slots=1),
+        replicas=1, reform_after_steps=2,
+    )
+    rep = FleetRouter(model, params, cfg).run(requests, kills=[(3, 0)])
+    assert rep.drains >= 1
+    # Terminal-state invariant: exactly one of finished/rejected/expired
+    # per touched request, and nobody exceeds their owed budget.
+    for rid, st in rep.requests.items():
+        states = sum(x is not None
+                     for x in (st.finished, st.rejected, st.expired))
+        assert states <= 1
+        assert len(st.tokens) <= st.max_new_tokens
+
+
+# ----------------------------------------------------- fixture replay
+
+
+@pytest.mark.parametrize("name", ["steady.json", "kill_drain.json"])
+def test_fixture_replays_clean(name):
+    fixture = json.loads((FIXTURES / name).read_text())
+    report = replay_fleet_fixture(fixture)
+    assert report["ok"], report["mismatches"]
+    assert not report["mismatches"]
+
+
+def test_fixture_version_gate():
+    fixture = json.loads((FIXTURES / "steady.json").read_text())
+    fixture["version"] = FLEET_FIXTURE_VERSION + 1
+    with pytest.raises(ValueError, match="fixture version"):
+        replay_fleet_fixture(fixture)
+
+
+def test_fixture_detects_drift():
+    """A wrong expectation must surface as a mismatch, not pass."""
+    fixture = json.loads((FIXTURES / "steady.json").read_text())
+    fixture["expect"]["generated_tokens"] += 1
+    report = replay_fleet_fixture(fixture)
+    assert not report["ok"]
+    assert "generated_tokens" in report["mismatches"]
+
+
+def test_fixture_cli_exits_zero():
+    from tpudml.serve.fleet.__main__ import main
+
+    assert main(["--fixture", str(FIXTURES / "steady.json")]) == 0
+
+
+# ------------------------------------------------ replan + membership
+
+
+def test_reform_consults_replanner(setup):
+    class Replanner:
+        def __init__(self):
+            self.calls = []
+
+        def replan(self, world, *, why):
+            self.calls.append((world, why))
+            return {"world": world}
+
+    model, params = setup
+    rp = Replanner()
+    cfg = FleetConfig(engine=_ecfg(), replicas=2, reform_after_steps=3)
+    rep = FleetRouter(model, params, cfg, replanner=rp).run(
+        _workload(8, 200.0, 3), kills=[(3, 1)]
+    )
+    assert rp.calls and rp.calls[0][1] == "fleet-reform replica 1"
+    assert rep.replans and rep.replans[0]["decision"] == {"world": 2}
+
+
+def test_raising_replanner_fails_open(setup):
+    class Bad:
+        def replan(self, world, *, why):
+            raise RuntimeError("planner down")
+
+    model, params = setup
+    cfg = FleetConfig(engine=_ecfg(), replicas=2, reform_after_steps=3)
+    rep = FleetRouter(model, params, cfg, replanner=Bad()).run(
+        _workload(8, 200.0, 3), kills=[(3, 1)]
+    )
+    # Re-form proceeded anyway, error recorded in the receipt.
+    assert rep.finished == 8
+    assert rep.replans and "RuntimeError" in rep.replans[0]["error"]
+
+
+def test_all_dead_without_reform_raises(setup):
+    model, params = setup
+    cfg = FleetConfig(engine=_ecfg(), replicas=1)
+    with pytest.raises(ValueError, match="no live replica"):
+        FleetRouter(model, params, cfg).run(
+            _workload(6, 200.0, 3), kills=[(1, 0)]
+        )
+
+
+# -------------------------------------------------- validation guards
+
+
+def test_fleet_config_validation():
+    with pytest.raises(ValueError, match="step_time_s"):
+        FleetConfig(engine=ServeConfig(slots=2, max_len=64,
+                                       prefill_chunk=8))
+    with pytest.raises(ValueError, match="replicas"):
+        FleetConfig(engine=_ecfg(), replicas=0)
+    with pytest.raises(ValueError, match="max_queue"):
+        FleetConfig(engine=_ecfg(), max_queue=0)
+    with pytest.raises(ValueError, match="reform_after_steps"):
+        FleetConfig(engine=_ecfg(), reform_after_steps=0)
+
+
+def test_fleet_rejects_spec():
+    """fleet × spec_k is a capability-table rejection: the router's
+    drain/re-admit continuation assumes one committed token per slot
+    per step (serve_fleet_spec)."""
+    with pytest.raises(ServeCompositionError, match="spec"):
+        FleetConfig(engine=_ecfg(spec_k=2))
+
+
+# ----------------------------------------------------- trace plumbing
+
+
+def test_trace_docs_merge_and_validate(setup):
+    from tpudml.obs.tracer import merge_chrome_traces, validate_chrome_trace
+
+    model, params = setup
+    cfg = FleetConfig(engine=_ecfg(), replicas=2, reform_after_steps=3)
+    rep = FleetRouter(model, params, cfg).run(
+        _workload(8, 200.0, 3), kills=[(3, 0)]
+    )
+    merged = merge_chrome_traces(rep.to_trace_docs(0.01))
+    validate_chrome_trace(merged)
+    names = {e["name"] for e in merged["traceEvents"]}
+    assert {"kill", "reform", "queue_depth"} <= names
+
+
+# --------------------------------------------------- spawned drill e2e
+
+
+@pytest.mark.slow
+def test_fleet_drill_survives_sigkill(tmp_path):
+    """Real processes, real SIGKILL: the victim replica dies mid-serve,
+    the controller re-forms, and every rank's final tokens match an
+    uninterrupted in-process reference (CRC over the sorted token
+    streams). Also pins the merged per-replica trace artifact and the
+    obs_report fleet section."""
+    from tools.obs_report import report as obs_report
+    from tpudml.serve.fleet import run_fleet_drill
+
+    rep = run_fleet_drill(tmp_path, world=2, requests=8, kill_rank=1,
+                          seed=0, timeout_s=240.0)
+    assert rep["ok"], rep
+    assert rep["reforms"] >= 1
+    assert rep["crc_ok"]
+    merged = Path(rep["merged_trace"])
+    assert merged.is_file()
+    doc = json.loads(merged.read_text())
+    pids = {e.get("pid") for e in doc["traceEvents"]}
+    assert {0, 1} <= pids  # one track per replica survived the merge
+    rendered = obs_report(tmp_path)
+    assert "fleet.json (serving fleet)" in rendered
+    assert "merged fleet trace" in rendered

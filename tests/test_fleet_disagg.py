@@ -1,0 +1,297 @@
+"""Disaggregated prefill/decode handoff + int8 weight quantization.
+
+Load-bearing properties: a decode replica that adopts a prefill
+replica's KV handoff serves BYTE-identical tokens to a single engine
+that prefilled locally (the pages hold bitwise-identical K/V, published
+under the same content hash); a vandalized handoff is rejected by the
+checkpoint store's CRC and the request transparently falls back to
+local prefill (same tokens, zero shared pages); int8 weight
+quantization is pinned to the ``_sim`` oracle bitwise, its
+reconstruction error is bounded by half a quantization step per
+channel, and the cost model prices the smaller param-byte term through
+ONE code path (``_params_bytes(itemsize=...)``).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from tpudml.models import TransformerLM
+from tpudml.resilience import vandalize
+from tpudml.serve import (
+    DecodeCostModel,
+    Request,
+    ServeCompositionError,
+    ServeConfig,
+    ServingEngine,
+    SLOConfig,
+)
+from tpudml.serve.fleet.disagg import adopt_handoff, write_handoff
+from tpudml.serve.fleet.quant import (
+    dequantize_params,
+    quantize_params,
+    quantized_param_bytes,
+    sim_quantize_params,
+)
+
+V = 48
+
+
+def _model():
+    return TransformerLM(vocab_size=V, embed_dim=32, num_heads=4,
+                         num_kv_heads=2, num_layers=2, max_len=64)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    model = _model()
+    params, state = model.init(jax.random.key(0))
+    return model, params, state
+
+
+def _paged_cfg(**kw):
+    base = dict(slots=2, max_len=64, prefill_chunk=8,
+                cache_layout="paged", page_size=8, prefix_sharing=True)
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+def _serve_one(model, params, cfg, prompt, n_new=6):
+    eng = ServingEngine(model, params, cfg)
+    report = eng.run([Request(rid=0, prompt=prompt, max_new_tokens=n_new)])
+    return eng, report.requests[0]
+
+
+# ------------------------------------------------------ KV handoff
+
+
+def test_handoff_adopt_greedy_parity(setup, tmp_path):
+    """Adopted pages ≡ local prefill: same tokens, and the adopting
+    engine's admit maps the shipped pages instead of prefilling them."""
+    model, params, _ = setup
+    cfg = _paged_cfg()
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, V, size=20).astype(np.int32)
+
+    info = write_handoff(model, params, cfg, prompt, tmp_path)
+    # 20-token prompt, first decode write at position 19 → pages 0..1
+    # (8-token pages) end strictly before it; page 2 is decode-dirty.
+    assert info["n_pages"] == 2
+    assert info["covered_tokens"] == 16
+
+    # Reference: an engine with NO handoff prefills everything locally.
+    _, ref = _serve_one(model, params, cfg, prompt)
+    assert ref.shared_pages == 0
+
+    eng = ServingEngine(model, params, cfg)
+    adopted = adopt_handoff(eng, tmp_path)
+    assert adopted == 2
+    report = eng.run([Request(rid=0, prompt=prompt, max_new_tokens=6)])
+    st = report.requests[0]
+    assert st.tokens == ref.tokens  # byte-exact greedy parity
+    assert st.shared_pages == 2  # served FROM the handoff, not prefill
+
+
+def test_vandalized_handoff_rejected_with_fallback(setup, tmp_path):
+    """CRC rollback: truncating the handoff payload makes adopt return
+    0 (strict=True raises instead), and the request falls back to local
+    prefill with identical tokens — correctness never depended on the
+    handoff, only prefill work did."""
+    from tpudml.checkpoint.store import CheckpointCorruptError
+
+    model, params, _ = setup
+    cfg = _paged_cfg()
+    rng = np.random.default_rng(1)
+    prompt = rng.integers(0, V, size=20).astype(np.int32)
+    write_handoff(model, params, cfg, prompt, tmp_path)
+    _, ref = _serve_one(model, params, cfg, prompt)
+
+    vandalize(tmp_path, "truncate")
+
+    eng = ServingEngine(model, params, cfg)
+    with pytest.raises(CheckpointCorruptError):
+        adopt_handoff(eng, tmp_path, strict=True)
+    assert adopt_handoff(eng, tmp_path) == 0  # quiet fallback path
+    report = eng.run([Request(rid=0, prompt=prompt, max_new_tokens=6)])
+    st = report.requests[0]
+    assert st.tokens == ref.tokens
+    assert st.shared_pages == 0  # nothing adopted, prefilled locally
+
+
+def test_handoff_config_mismatch_raises(setup, tmp_path):
+    """Wrong page size at adopt is a wiring bug, not a fault — always
+    loud, even without strict."""
+    model, params, _ = setup
+    prompt = np.arange(20, dtype=np.int32) % V
+    write_handoff(model, params, _paged_cfg(), prompt, tmp_path)
+    eng = ServingEngine(model, params, _paged_cfg(page_size=16))
+    with pytest.raises(ValueError, match="mismatch"):
+        adopt_handoff(eng, tmp_path)
+
+
+def test_handoff_requires_paged_sharing(setup, tmp_path):
+    model, params, _ = setup
+    dense = ServeConfig(slots=2, max_len=64, prefill_chunk=8)
+    with pytest.raises(ValueError, match="prefix_sharing"):
+        write_handoff(model, params, dense,
+                      np.arange(20, dtype=np.int32), tmp_path)
+
+
+def test_sub_page_prompt_hands_off_nothing(setup, tmp_path):
+    """A prompt smaller than one page has no shareable prefix: n_pages
+    is 0 and adopt is a no-op (decode falls back to local prefill)."""
+    model, params, _ = setup
+    cfg = _paged_cfg()
+    info = write_handoff(model, params, cfg,
+                         np.arange(5, dtype=np.int32), tmp_path)
+    assert info["n_pages"] == 0
+    eng = ServingEngine(model, params, cfg)
+    assert adopt_handoff(eng, tmp_path) == 0
+
+
+# ------------------------------------------------ int8 weight quant
+
+
+def test_quant_matches_sim_oracle_bitwise(setup):
+    """dequantize(quantize(p)) must equal the ``_sim`` oracle bitwise —
+    the cache.py discipline: the real storage path and the f32-storage
+    simulation are the same arithmetic."""
+    _, params, _ = setup
+    qparams, scales = quantize_params(params)
+    deq = dequantize_params(qparams, scales)
+    sim = sim_quantize_params(params)
+
+    flat_d, _ = jax.tree_util.tree_flatten(deq)
+    flat_s, _ = jax.tree_util.tree_flatten(sim)
+    assert len(flat_d) == len(flat_s)
+    for d, s in zip(flat_d, flat_s):
+        np.testing.assert_array_equal(np.asarray(d), np.asarray(s))
+
+
+def test_quant_error_bounded_by_half_step(setup):
+    """Per-output-channel absmax reconstruction error: |w − dq(q(w))|
+    ≤ scale/2 elementwise on every 2-D kernel; non-kernel leaves pass
+    through untouched (bitwise)."""
+    _, params, _ = setup
+    qparams, scales = quantize_params(params)
+    deq = dequantize_params(qparams, scales)
+
+    def walk(orig, dq, sc):
+        for name in orig:
+            o, d, s = orig[name], dq[name], sc[name]
+            if isinstance(o, dict):
+                walk(o, d, s)
+            elif s is None:
+                np.testing.assert_array_equal(np.asarray(o), np.asarray(d))
+            else:
+                o, d = np.asarray(o), np.asarray(d)
+                bound = 0.5 * np.asarray(s)[None, :] + 1e-7
+                assert np.all(np.abs(o - d) <= bound)
+
+    walk(params, deq, scales)
+
+
+def test_engine_weight_quant_real_equals_sim(setup):
+    """An int8 engine and an int8_sim engine hold bitwise-identical
+    decode params — the flag changes STORAGE, never arithmetic — and
+    both serve exact token accounting."""
+    model, params, _ = setup
+    cfg_real = ServeConfig(slots=2, max_len=64, prefill_chunk=8,
+                           weight_quant="int8")
+    cfg_sim = ServeConfig(slots=2, max_len=64, prefill_chunk=8,
+                          weight_quant="int8_sim")
+    eng_real = ServingEngine(model, params, cfg_real)
+    eng_sim = ServingEngine(model, params, cfg_sim)
+    flat_r, _ = jax.tree_util.tree_flatten(eng_real.params)
+    flat_s, _ = jax.tree_util.tree_flatten(eng_sim.params)
+    for r, s in zip(flat_r, flat_s):
+        np.testing.assert_array_equal(np.asarray(r), np.asarray(s))
+    assert eng_real.quantized_params is not None
+    assert eng_sim.quantized_params is None
+
+    rng = np.random.default_rng(2)
+    prompt = rng.integers(0, V, size=12).astype(np.int32)
+    rep = eng_real.run([Request(rid=0, prompt=prompt, max_new_tokens=6)])
+    assert len(rep.requests[0].tokens) == 6
+
+
+def test_engine_weight_quant_atol_parity(setup):
+    """Quantized decode stays close to f32 decode where it matters: the
+    forward logits of the dequantized params are atol-bounded against
+    the exact params (the acceptance bound — token streams MAY differ
+    at argmax ties, logits may not drift)."""
+    model, params, state = setup
+    deq = dequantize_params(*quantize_params(params))
+    rng = np.random.default_rng(3)
+    tokens = rng.integers(0, V, size=(1, 12)).astype(np.int32)
+    exact = np.asarray(model.apply(params, state, tokens)[0])
+    quant = np.asarray(model.apply(deq, state, tokens)[0])
+    assert np.max(np.abs(exact - quant)) < 0.15, (
+        np.max(np.abs(exact - quant))
+    )
+
+
+def test_quantized_param_bytes(setup):
+    """int8 storage is strictly smaller than f32 and dominated by the
+    kernel leaves (1 byte/element + a per-channel f32 scale row)."""
+    _, params, _ = setup
+    qparams, scales = quantize_params(params)
+    q_bytes = quantized_param_bytes(qparams, scales)
+    f32_bytes = sum(
+        np.asarray(x).nbytes for x in jax.tree_util.tree_leaves(params)
+    )
+    assert q_bytes < f32_bytes / 2
+
+
+def test_engine_rejects_unknown_weight_quant():
+    with pytest.raises(ValueError, match="weight_quant"):
+        ServeConfig(slots=2, max_len=64, prefill_chunk=8,
+                    weight_quant="int4")
+
+
+def test_tp_rejects_weight_quant(setup):
+    """TP × weight_quant is a capability-table rejection
+    (``serve_tp_weight_quant``): the TP engine shards the ORIGINAL
+    params; serving dequantized weights under shard_map would silently
+    serve different arithmetic per composition."""
+    from tpudml.core.config import MeshConfig
+    from tpudml.core.dist import make_mesh
+
+    model, params, _ = setup
+    mesh = make_mesh(MeshConfig({"model": 2}), jax.devices()[:2])
+    cfg = ServeConfig(slots=2, max_len=64, prefill_chunk=8,
+                      weight_quant="int8")
+    with pytest.raises(ServeCompositionError, match="weight_quant"):
+        ServingEngine(model, params, cfg, mesh=mesh, axis_name="model")
+
+
+# ------------------------------------------- cost-model param pricing
+
+
+def test_params_bytes_single_code_path():
+    """``_params_bytes`` is parameterized by itemsize — every dtype
+    prices through the SAME element count, so the ratios are exact."""
+    model = _model()
+    f32 = DecodeCostModel._params_bytes(model, itemsize=4)
+    bf16 = DecodeCostModel._params_bytes(model, itemsize=2)
+    int8 = DecodeCostModel._params_bytes(model, itemsize=1)
+    assert f32 == 2 * bf16 == 4 * int8
+
+
+def test_cost_model_prices_weight_quant():
+    """The fleet's placement honesty: an int8 replica's cost model
+    carries exactly ¼ the param-byte term; the ``int8_sim`` oracle
+    still prices as f32 (it STORES f32 — pricing it as int8 would be
+    the dishonest-placement bug)."""
+    model = _model()
+    slo = SLOConfig(tpot_budget_s=0.5)
+
+    def cm(wq):
+        cfg = ServeConfig(slots=2, max_len=64, prefill_chunk=8,
+                          weight_quant=wq)
+        return DecodeCostModel(model, cfg, slo)
+
+    assert cm(None).params_bytes == cm("int8").params_bytes * 4
+    assert cm("int8_sim").params_bytes == cm(None).params_bytes
+    # Fewer param bytes → cheaper predicted step at equal occupancy.
+    assert cm("int8").step_seconds(1) < cm(None).step_seconds(1)

@@ -25,7 +25,8 @@ fsdp_tp / pp_dp / ep``), ``mesh`` (axis-name → size dict), ``zero1``,
 ``zero1_overlap``, ``accum_steps``, ``fused_xent``, ``save_scores``,
 ``measure_comm``, ``custom_loss``, ``aggregation``, ``dropout``,
 ``moe_experts``, ``grad_clip``, ``schedule``, ``serve_tp``,
-``serve_cache_layout``, ``serve_spec_k``.  Entries with ``when=None``
+``serve_cache_layout``, ``serve_spec_k``, ``serve_weight_quant``,
+``serve_fleet``.  Entries with ``when=None``
 are constructor-level invariants the planner can never generate (e.g.
 handing a pre-wrapped ZeRO1 optimizer to a non-zero1 engine) — they
 still own their runtime message here so the guard text stays in the
@@ -216,6 +217,28 @@ _ENTRIES = (
             _g(c, "serve_cache_layout", "dense") == "paged"
             or _g(c, "serve_spec_k", 0) > 0
         ),
+    ),
+    Capability(
+        key="serve_tp_weight_quant",
+        owner="tpudml.serve.engine",
+        message=(
+            "tensor-parallel serving does not compose with "
+            "weight_quant: shard_params knows nothing of int8 kernels "
+            "+ scale trees; quantize single-device replicas"
+        ),
+        when=lambda c: bool(_g(c, "serve_tp"))
+        and _g(c, "serve_weight_quant") is not None,
+    ),
+    Capability(
+        key="serve_fleet_spec",
+        owner="tpudml.serve.fleet.router",
+        message=(
+            "fleet replicas do not compose with spec_k>0 yet: the "
+            "router's drain/re-admit continuation assumes one committed "
+            "token per slot per step; run spec single-engine"
+        ),
+        when=lambda c: bool(_g(c, "serve_fleet"))
+        and _g(c, "serve_spec_k", 0) > 0,
     ),
     Capability(
         key="serve_tp_dense_only",

@@ -627,200 +627,202 @@ def run_shrink_drill(
     # Controller-side tracer: the reform/replan instants land in their
     # own exported track (the children export theirs per-rank).
     tracer = Tracer()
-    set_tracer(tracer)
-
-    # (1) plan the launch. dp/zero1 lattice: at world>=2 the planner
-    # picks ZeRO-1 (+accum, overlap hidden); at world 1 ZeRO-1 has no
-    # mesh, so a shrink forces a genuine chain switch.
-    rp = Replanner(
-        flagship_lm(),
-        engines=["dp", "zero1"],
-        verify=False,
-        plan_path=plan_path,
-    )
-    old_plan = rp.initial_plan(world)
-    old_key = old_plan["winner"]["candidate"]["key"]
-    old_engine = old_plan["engine_config"]["engine"]
-    old_accum = old_plan["engine_config"]["accum_steps"]
-
-    child = [
-        sys.executable, "-u", "-m", "tpudml.elastic.drill",
-        "--steps", str(steps),
-        "--ckpt_every", str(ckpt_every),
-        "--seed", str(seed),
-        "--plan", str(plan_path),
-    ]
-    spec = ClusterSpec(num_processes=world, timeout_s=timeout_s, grace_s=3.0)
-
-    # (2) the drill: shrink policy + replanner.
-    marker = base / "kill.marker"
-    drill_cmd = child + [
-        "--ckpt_dir", str(ckpt_dir),
-        "--obs_dir", str(obs_dir),
-        "--kill_step", str(kill_step),
-        "--kill_rank", str(kill_rank),
-        "--kill_marker", str(marker),
-    ]
-    drill_log = io.StringIO()
-    ctrl = ElasticController(
-        drill_cmd,
-        dataclasses.replace(
-            spec,
-            restart_backoff_s=backoff_s,
-            restart_backoff_jitter=0.5,
-            restart_backoff_seed=seed,
-        ),
-        policy="shrink",
-        min_world=1,
-        max_reforms=2,
-        replanner=rp,
-        sink=_Tee(drill_log, sink),
-    )
-    eres = ctrl.run()
-    finals = _parse_finals(drill_log.getvalue())
-    resumes = _parse_resumes(drill_log.getvalue())
-    new_plan = rp.plan
-    new_key = new_plan["winner"]["candidate"]["key"]
-    new_engine = new_plan["engine_config"]["engine"]
-    new_accum = new_plan["engine_config"]["accum_steps"]
-    replan = eres.replans[0] if eres.replans else None
-    (obs_dir / "elastic.json").write_text(
-        json.dumps(eres.to_dict(), indent=2, sort_keys=True) + "\n"
-    )
-    tracer.export(obs_dir / "trace_controller.json")
-
-    resume_step = min((s for _, s, _ in resumes), default=None)
-    steps_lost = kill_step - resume_step if resume_step is not None else None
-    restart_latency_s = (
-        max(w for _, _, w in resumes) - eres.records[0].t_end
-        if resumes and len(eres.records) >= 2
-        else None
-    )
-    final = finals.get(0)
-
-    # (3) the reference arm: new chain, same checkpoint, uninterrupted.
-    bit_exact = False
-    ref_final = None
-    if resume_step is not None and final is not None:
-        _copy_step(ckpt_dir, resume_step, base / "ref_ckpt")
-        ref_log = io.StringIO()
-        ref = launch(
-            child + ["--ckpt_dir", str(base / "ref_ckpt")],
-            dataclasses.replace(spec, num_processes=world - 1),
-            sink=_Tee(ref_log, sink),
+    prev_tracer = set_tracer(tracer)
+    try:
+        # (1) plan the launch. dp/zero1 lattice: at world>=2 the planner
+        # picks ZeRO-1 (+accum, overlap hidden); at world 1 ZeRO-1 has no
+        # mesh, so a shrink forces a genuine chain switch.
+        rp = Replanner(
+            flagship_lm(),
+            engines=["dp", "zero1"],
+            verify=False,
+            plan_path=plan_path,
         )
-        ref_final = _parse_finals(ref_log.getvalue()).get(0)
-        bit_exact = (
-            ref.success
-            and ref_final is not None
-            and ref_final["params_crc"] == final["params_crc"]
-            and ref_final["loss_crc"] == final["loss_crc"]
+        old_plan = rp.initial_plan(world)
+        old_key = old_plan["winner"]["candidate"]["key"]
+        old_engine = old_plan["engine_config"]["engine"]
+        old_accum = old_plan["engine_config"]["accum_steps"]
+
+        child = [
+            sys.executable, "-u", "-m", "tpudml.elastic.drill",
+            "--steps", str(steps),
+            "--ckpt_every", str(ckpt_every),
+            "--seed", str(seed),
+            "--plan", str(plan_path),
+        ]
+        spec = ClusterSpec(num_processes=world, timeout_s=timeout_s, grace_s=3.0)
+
+        # (2) the drill: shrink policy + replanner.
+        marker = base / "kill.marker"
+        drill_cmd = child + [
+            "--ckpt_dir", str(ckpt_dir),
+            "--obs_dir", str(obs_dir),
+            "--kill_step", str(kill_step),
+            "--kill_rank", str(kill_rank),
+            "--kill_marker", str(marker),
+        ]
+        drill_log = io.StringIO()
+        ctrl = ElasticController(
+            drill_cmd,
+            dataclasses.replace(
+                spec,
+                restart_backoff_s=backoff_s,
+                restart_backoff_jitter=0.5,
+                restart_backoff_seed=seed,
+            ),
+            policy="shrink",
+            min_world=1,
+            max_reforms=2,
+            replanner=rp,
+            sink=_Tee(drill_log, sink),
         )
-
-    # (4) the naive A/B arm: old chain forced at the shrunken world by
-    # explicit flags (explicit CLI beats the plan file).
-    naive = None
-    replan_beats_naive = None
-    if include_naive and resume_step is not None and final is not None:
-        _copy_step(ckpt_dir, resume_step, base / "naive_ckpt")
-        naive_log = io.StringIO()
-        naive_res = launch(
-            child + [
-                "--ckpt_dir", str(base / "naive_ckpt"),
-                "--engine", str(old_engine),
-                "--accum_steps", str(old_accum),
-            ],
-            dataclasses.replace(spec, num_processes=world - 1),
-            sink=_Tee(naive_log, sink),
+        eres = ctrl.run()
+        finals = _parse_finals(drill_log.getvalue())
+        resumes = _parse_resumes(drill_log.getvalue())
+        new_plan = rp.plan
+        new_key = new_plan["winner"]["candidate"]["key"]
+        new_engine = new_plan["engine_config"]["engine"]
+        new_accum = new_plan["engine_config"]["accum_steps"]
+        replan = eres.replans[0] if eres.replans else None
+        (obs_dir / "elastic.json").write_text(
+            json.dumps(eres.to_dict(), indent=2, sort_keys=True) + "\n"
         )
-        naive_final = _parse_finals(naive_log.getvalue()).get(0)
-        if naive_res.success and naive_final is not None:
-            naive = {
-                "engine": naive_final["engine"],
-                "accum_steps": naive_final["accum_steps"],
-                "steps_per_s": naive_final["steps_per_s"],
-                "params_crc": naive_final["params_crc"],
-            }
-            replan_beats_naive = (
-                final["steps_per_s"] > naive_final["steps_per_s"]
+        tracer.export(obs_dir / "trace_controller.json")
+
+        resume_step = min((s for _, s, _ in resumes), default=None)
+        steps_lost = kill_step - resume_step if resume_step is not None else None
+        restart_latency_s = (
+            max(w for _, _, w in resumes) - eres.records[0].t_end
+            if resumes and len(eres.records) >= 2
+            else None
+        )
+        final = finals.get(0)
+
+        # (3) the reference arm: new chain, same checkpoint, uninterrupted.
+        bit_exact = False
+        ref_final = None
+        if resume_step is not None and final is not None:
+            _copy_step(ckpt_dir, resume_step, base / "ref_ckpt")
+            ref_log = io.StringIO()
+            ref = launch(
+                child + ["--ckpt_dir", str(base / "ref_ckpt")],
+                dataclasses.replace(spec, num_processes=world - 1),
+                sink=_Tee(ref_log, sink),
+            )
+            ref_final = _parse_finals(ref_log.getvalue()).get(0)
+            bit_exact = (
+                ref.success
+                and ref_final is not None
+                and ref_final["params_crc"] == final["params_crc"]
+                and ref_final["loss_crc"] == final["loss_crc"]
             )
 
-    # Trace evidence: the surviving incarnation's rank 0 track merges.
-    pids: list[int] = []
-    trace_files = sorted(obs_dir.glob("trace_p*.json"))
-    if trace_files:
-        try:
-            merged = merge_chrome_traces(
-                [json.loads(p.read_text()) for p in trace_files]
+        # (4) the naive A/B arm: old chain forced at the shrunken world by
+        # explicit flags (explicit CLI beats the plan file).
+        naive = None
+        replan_beats_naive = None
+        if include_naive and resume_step is not None and final is not None:
+            _copy_step(ckpt_dir, resume_step, base / "naive_ckpt")
+            naive_log = io.StringIO()
+            naive_res = launch(
+                child + [
+                    "--ckpt_dir", str(base / "naive_ckpt"),
+                    "--engine", str(old_engine),
+                    "--accum_steps", str(old_accum),
+                ],
+                dataclasses.replace(spec, num_processes=world - 1),
+                sink=_Tee(naive_log, sink),
             )
-            validate_chrome_trace(merged)
-            (obs_dir / "trace.json").write_text(
-                json.dumps(merged, sort_keys=True, separators=(",", ":")) + "\n"
-            )
-            pids = sorted(
-                {e["pid"] for e in merged["traceEvents"] if e["ph"] != "M"}
-            )
-        except ValueError:
-            pids = []
+            naive_final = _parse_finals(naive_log.getvalue()).get(0)
+            if naive_res.success and naive_final is not None:
+                naive = {
+                    "engine": naive_final["engine"],
+                    "accum_steps": naive_final["accum_steps"],
+                    "steps_per_s": naive_final["steps_per_s"],
+                    "params_crc": naive_final["params_crc"],
+                }
+                replan_beats_naive = (
+                    final["steps_per_s"] > naive_final["steps_per_s"]
+                )
 
-    ports = [r.coordinator_port for r in eres.records]
-    receipts = list(replan["receipts"]) if replan else []
-    plan_switched = bool(replan and replan.get("switched") and not replan.get("error"))
-    chain_switched = (
-        final is not None
-        and final["engine"] == new_engine
-        and new_engine != old_engine
-    )
-    ok = (
-        eres.success
-        and eres.reforms == 1
-        and eres.final_world == world - 1
-        and plan_switched
-        and chain_switched
-        and bool(receipts)
-        and resume_step is not None
-        and steps_lost is not None
-        and steps_lost >= 0
-        and bit_exact
-        and len(set(ports)) == len(ports)
-    )
-    return {
-        "ok": ok,
-        "mode": "shrink_replan",
-        "bit_exact": bit_exact,
-        "world": world,
-        "final_world": eres.final_world,
-        "steps": steps,
-        "kill_step": kill_step,
-        "kill_rank": kill_rank,
-        "killed_rank_observed": eres.records[0].failed_rank
-        if eres.records
-        else None,
-        "resume_step": resume_step,
-        "steps_lost": steps_lost,
-        "reforms": eres.reforms,
-        "coordinator_ports": ports,
-        "fresh_port": len(set(ports)) == len(ports),
-        "backoff_s": eres.records[-1].backoff_s if eres.reforms else 0.0,
-        "restart_latency_s": restart_latency_s,
-        "drill_wall_s": eres.total_elapsed_s,
-        "old_plan": {
-            "key": old_key, "engine": old_engine, "accum_steps": old_accum,
-        },
-        "new_plan": {
-            "key": new_key, "engine": new_engine, "accum_steps": new_accum,
-        },
-        "plan_switched": plan_switched,
-        "chain_switched": chain_switched,
-        "replan_latency_s": replan["latency_s"] if replan else None,
-        "replan_receipts": receipts,
-        "params_crc": final["params_crc"] if final else None,
-        "loss_crc": final["loss_crc"] if final else None,
-        "post_shrink_steps_per_s": final["steps_per_s"] if final else None,
-        "naive": naive,
-        "replan_beats_naive": replan_beats_naive,
-        "trace_pids": pids,
-    }
+        # Trace evidence: the surviving incarnation's rank 0 track merges.
+        pids: list[int] = []
+        trace_files = sorted(obs_dir.glob("trace_p*.json"))
+        if trace_files:
+            try:
+                merged = merge_chrome_traces(
+                    [json.loads(p.read_text()) for p in trace_files]
+                )
+                validate_chrome_trace(merged)
+                (obs_dir / "trace.json").write_text(
+                    json.dumps(merged, sort_keys=True, separators=(",", ":")) + "\n"
+                )
+                pids = sorted(
+                    {e["pid"] for e in merged["traceEvents"] if e["ph"] != "M"}
+                )
+            except ValueError:
+                pids = []
+
+        ports = [r.coordinator_port for r in eres.records]
+        receipts = list(replan["receipts"]) if replan else []
+        plan_switched = bool(replan and replan.get("switched") and not replan.get("error"))
+        chain_switched = (
+            final is not None
+            and final["engine"] == new_engine
+            and new_engine != old_engine
+        )
+        ok = (
+            eres.success
+            and eres.reforms == 1
+            and eres.final_world == world - 1
+            and plan_switched
+            and chain_switched
+            and bool(receipts)
+            and resume_step is not None
+            and steps_lost is not None
+            and steps_lost >= 0
+            and bit_exact
+            and len(set(ports)) == len(ports)
+        )
+        return {
+            "ok": ok,
+            "mode": "shrink_replan",
+            "bit_exact": bit_exact,
+            "world": world,
+            "final_world": eres.final_world,
+            "steps": steps,
+            "kill_step": kill_step,
+            "kill_rank": kill_rank,
+            "killed_rank_observed": eres.records[0].failed_rank
+            if eres.records
+            else None,
+            "resume_step": resume_step,
+            "steps_lost": steps_lost,
+            "reforms": eres.reforms,
+            "coordinator_ports": ports,
+            "fresh_port": len(set(ports)) == len(ports),
+            "backoff_s": eres.records[-1].backoff_s if eres.reforms else 0.0,
+            "restart_latency_s": restart_latency_s,
+            "drill_wall_s": eres.total_elapsed_s,
+            "old_plan": {
+                "key": old_key, "engine": old_engine, "accum_steps": old_accum,
+            },
+            "new_plan": {
+                "key": new_key, "engine": new_engine, "accum_steps": new_accum,
+            },
+            "plan_switched": plan_switched,
+            "chain_switched": chain_switched,
+            "replan_latency_s": replan["latency_s"] if replan else None,
+            "replan_receipts": receipts,
+            "params_crc": final["params_crc"] if final else None,
+            "loss_crc": final["loss_crc"] if final else None,
+            "post_shrink_steps_per_s": final["steps_per_s"] if final else None,
+            "naive": naive,
+            "replan_beats_naive": replan_beats_naive,
+            "trace_pids": pids,
+        }
+    finally:
+        set_tracer(prev_tracer)
 
 
 if __name__ == "__main__":

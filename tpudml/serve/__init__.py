@@ -7,8 +7,11 @@ acceptance-rejection), ``sched`` (SLO-aware admission priced on the
 static cost model), ``engine`` (ONE jitted decode step + chunked
 prefill + slot scheduler composing all of the above), ``load`` (seeded
 Poisson request streams), ``tp`` (the dense steps under shard_map on a
-tensor-parallel mesh; TP × {paged, spec} raises
-ServeCompositionError). See docs/API.md §Serving.
+tensor-parallel mesh; TP × {paged, spec, weight_quant} raises
+ServeCompositionError), ``fleet`` (scale-OUT: multi-replica router with
+drain/re-admit membership, disaggregated prefill/decode handoff, int8
+weight quantization — imported lazily, see ``tpudml.serve.fleet``).
+See docs/API.md §Serving.
 """
 
 from tpudml.serve.cache import KVCache, cache_bytes, init_cache
@@ -38,7 +41,28 @@ from tpudml.serve.spec import (
     make_spec_decode_step,
 )
 
+_FLEET_EXPORTS = (
+    "FleetConfig", "FleetReport", "FleetRequestStats", "FleetRouter",
+    "replay_fleet_fixture",
+)
+
+
+def __getattr__(name):
+    # Lazy: the fleet tier pulls in the checkpoint store (disagg handoff)
+    # and, for the drill, the elastic controller stack — none of which a
+    # plain single-engine import should pay for.
+    if name in _FLEET_EXPORTS:
+        import tpudml.serve.fleet as fleet
+
+        return getattr(fleet, name)
+    raise AttributeError(name)
+
+
 __all__ = [
+    "FleetConfig",
+    "FleetReport",
+    "FleetRequestStats",
+    "FleetRouter",
     "KVCache",
     "PAGED_DECODE_MARKER",
     "PagePool",
@@ -63,4 +87,5 @@ __all__ = [
     "make_spec_decode_step",
     "poisson_workload",
     "pool_bytes",
+    "replay_fleet_fixture",
 ]

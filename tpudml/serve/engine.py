@@ -185,6 +185,12 @@ class ServeConfig:
     # the per-token budget; otherwise it waits (event
     # ``("defer", rid, -1, step)`` on first deferral).
     slo: SLOConfig | None = None
+    # Weight quantization for the cache-bound decode path
+    # (serve/fleet/quant.py): "int8" stores per-output-channel absmax
+    # int8 kernels + f32 scales and computes on their dequantization;
+    # "int8_sim" is the f32-storage oracle (quantize→dequantize
+    # round-trip) the real path must match bitwise. None: f32 weights.
+    weight_quant: str | None = None
 
     def __post_init__(self):
         if self.slots < 1:
@@ -226,6 +232,11 @@ class ServeConfig:
             raise ValueError("prefix_sharing requires cache_layout='paged'")
         if self.spec_k < 0:
             raise ValueError("spec_k must be >= 0")
+        if self.weight_quant not in (None, "int8", "int8_sim"):
+            raise ValueError(
+                f"weight_quant must be None, 'int8' or 'int8_sim', "
+                f"got {self.weight_quant!r}"
+            )
 
     @property
     def max_pages(self) -> int:
@@ -404,6 +415,35 @@ class ServingEngine:
             # Until those bodies exist, composing would silently run the
             # unsharded math on sharded params — reject instead.
             reject("serve_tp_paged_spec", exc=ServeCompositionError)
+        if mesh is not None and cfg.weight_quant is not None:
+            # shard_params knows nothing of int8 kernels + scale trees;
+            # sharding the dequantized params would silently price (and
+            # store) f32 while claiming int8 — reject instead.
+            reject("serve_tp_weight_quant", exc=ServeCompositionError)
+        # Weight quantization happens ONCE at init: decode compute runs
+        # on the dequantized params (bitwise identical to the int8_sim
+        # oracle — quant.py's contract), while the "int8" mode keeps the
+        # int8 kernels + scales as the params of record so storage
+        # accounting (quantized_param_bytes) reflects what a chip would
+        # actually hold resident.
+        self.quantized_params = None
+        self.quant_scales = None
+        if cfg.weight_quant is not None:
+            from tpudml.serve.fleet.quant import (
+                dequantize_params,
+                quantize_params,
+                sim_quantize_params,
+            )
+
+            if cfg.weight_quant == "int8":
+                self.quantized_params, self.quant_scales = quantize_params(
+                    params
+                )
+                params = dequantize_params(
+                    self.quantized_params, self.quant_scales
+                )
+            else:  # int8_sim: the f32-storage oracle
+                params = sim_quantize_params(params)
         self._tp = None
         if mesh is not None:
             from tpudml.serve.tp import TPServing

@@ -1,0 +1,133 @@
+"""int8 weight quantization for the cache-bound decode path.
+
+Decode is memory-bound: every step streams the weights once, so halving
+or quartering stored parameter bytes buys cadence directly (the
+``DecodeCostModel`` param term — serve/sched.py now prices it by dtype).
+This module quantizes the model's matmul kernels to int8 with
+per-OUTPUT-channel absmax scales, the weight-side twin of the KV
+cache's per-row scheme (serve/cache.py ``_quant``): with the kernel
+laid out [in, out], one f32 scale per output column keeps each column's
+dynamic range independent, which is what absmax needs — Dense columns
+are the unit fan-in-normalized init and training perturb independently.
+
+Eligibility is *name-based and total*: every param-tree leaf named
+``"kernel"`` with ndim == 2 (attention q/k/v/out projections, fc1/fc2,
+the LM head) is quantized; embeddings (``tok_embed``/``pos_embed``),
+LayerNorm scale/bias, and biases stay f32 — they are a rounding error
+of the byte budget and disproportionately sensitive to rounding.
+
+The correctness contract mirrors the cache's ``_sim`` oracle pattern:
+
+- :func:`sim_quantize_params` is the oracle — a quantize→dequantize
+  round-trip that keeps f32 storage (so it prices like f32, see
+  ``serve/sched.py _PARAM_ITEMSIZE``);
+- :func:`quantize_params` + :func:`dequantize_params` is the real path
+  (int8 storage + f32 scales), and its dequantization must equal the
+  oracle BITWISE — same ops in the same order, only the storage differs;
+- decode logits under either mode are atol-close to f32 (the parity
+  test in tests/test_fleet_quant.py); exact token equality is NOT
+  promised — rounded weights may legitimately flip an argmax.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from tpudml.serve.cache import _SCALE_EPS
+
+
+def _quant_kernel(w) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """kernel [in, out] f32 -> (int8 codes [in, out], f32 scale [out]).
+
+    Same absmax/127 + ``_SCALE_EPS`` floor + round/clip sequence as the
+    cache's ``_quant``, with the reduction over the INPUT axis so each
+    output channel owns its scale."""
+    wf = jnp.asarray(w, jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(wf), axis=0), _SCALE_EPS) / 127.0
+    q = jnp.clip(jnp.round(wf / scale[None, :]), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+def _dequant_kernel(q, scale) -> jnp.ndarray:
+    return q.astype(jnp.float32) * scale[None, :]
+
+
+def _is_kernel(name: str, leaf) -> bool:
+    return name == "kernel" and getattr(leaf, "ndim", 0) == 2
+
+
+def _walk(tree, fn):
+    """Map ``fn(name, leaf)`` over a nested-dict param tree's leaves."""
+    if isinstance(tree, dict):
+        return {k: _walk_named(k, v, fn) for k, v in tree.items()}
+    return fn(None, tree)
+
+
+def _walk_named(name, node, fn):
+    if isinstance(node, dict):
+        return {k: _walk_named(k, v, fn) for k, v in node.items()}
+    return fn(name, node)
+
+
+def quantize_params(params: dict) -> tuple[dict, dict]:
+    """Real int8 path: returns ``(qparams, scales)`` — the param tree
+    with every eligible kernel stored as int8, and a parallel tree
+    holding the f32 per-output-channel scales at exactly the quantized
+    paths (non-quantized leaves carry None)."""
+
+    def _q(node, name=None):
+        if isinstance(node, dict):
+            pairs = {k: _q(v, k) for k, v in node.items()}
+            return (
+                {k: p[0] for k, p in pairs.items()},
+                {k: p[1] for k, p in pairs.items()},
+            )
+        if _is_kernel(name, node):
+            return _quant_kernel(node)
+        return node, None
+
+    return _q(params)
+
+
+def dequantize_params(qparams: dict, scales: dict) -> dict:
+    """Inverse of :func:`quantize_params` — bitwise-equal to the
+    :func:`sim_quantize_params` oracle on the same input params."""
+
+    def _deq(q, s):
+        if isinstance(q, dict):
+            return {k: _deq(q[k], s[k]) for k in q}
+        if s is None:
+            return q
+        return _dequant_kernel(q, s)
+
+    return _deq(qparams, scales)
+
+
+def sim_quantize_params(params: dict) -> dict:
+    """The ``_sim`` oracle: quantize→dequantize every eligible kernel,
+    keeping f32 storage. The real path's dequantization must match this
+    bitwise (pinned in tests) — the simulation IS the spec."""
+
+    def _sim(n, w):
+        if not _is_kernel(n, w):
+            return w
+        return _dequant_kernel(*_quant_kernel(w))
+
+    return _walk(params, _sim)
+
+
+def quantized_param_bytes(qparams: dict, scales: dict) -> int:
+    """Actually-stored bytes of the real int8 tree (int8 kernels + f32
+    scales + untouched f32 leaves) — what a chip would hold resident,
+    for honest accounting next to ``DecodeCostModel._params_bytes``."""
+
+    def _bytes(q, s):
+        if isinstance(q, dict):
+            return sum(_bytes(q[k], s[k]) for k in q)
+        total = int(np.asarray(q).nbytes)
+        if s is not None:
+            total += int(np.asarray(s).nbytes)
+        return total
+
+    return _bytes(qparams, scales)

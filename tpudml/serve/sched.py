@@ -34,6 +34,13 @@ from tpudml.comm.timing import collective_wire_bytes
 
 _CACHE_ITEMSIZE = {"f32": 4, "bf16": 2, "int8": 1, "bf16_sim": 4, "int8_sim": 4}
 
+# Stored bytes per PARAMETER element, keyed by ServeConfig.weight_quant.
+# Same convention as the cache table above: the "_sim" oracle keeps f32
+# storage (it only rounds values), so it prices like f32 — pricing the
+# sim as if it saved bytes would be the dishonest-placement bug the
+# fleet router's SLO pricing exists to avoid.
+_PARAM_ITEMSIZE = {None: 4, "f32": 4, "bf16": 2, "int8": 1, "int8_sim": 4}
+
 
 @dataclass(frozen=True)
 class SLOConfig:
@@ -86,11 +93,17 @@ class DecodeCostModel:
         self.per_slot_bytes = (
             2 * window_rows * kv_heads * head_dim * itemsize * model.num_layers
         )
-        self.params_bytes = self._params_bytes(model) // max(world, 1)
+        p_item = _PARAM_ITEMSIZE[getattr(cfg, "weight_quant", None)]
+        self.params_bytes = (
+            self._params_bytes(model, itemsize=p_item) // max(world, 1)
+        )
         self.draft_bytes = 0
         self.spec_k = cfg.spec_k or 0
         if draft_model is not None and self.spec_k:
-            self.draft_bytes = self._params_bytes(draft_model) // max(world, 1)
+            self.draft_bytes = (
+                self._params_bytes(draft_model, itemsize=p_item)
+                // max(world, 1)
+            )
         # Two activation allreduces per block per step under TP (attn.out
         # + mlp.fc2 — serve/tp.py), priced on the shared ring model.
         act_bytes = model.embed_dim * 4
@@ -100,13 +113,16 @@ class DecodeCostModel:
         )
 
     @staticmethod
-    def _params_bytes(model) -> int:
+    def _params_bytes(model, *, itemsize: int = 4) -> int:
+        """Stored parameter bytes at ``itemsize`` bytes/element — the ONE
+        param-pricing code path for every weight dtype (f32/bf16/int8):
+        quantization changes the multiplier, never the element count."""
         d, v, l = model.embed_dim, model.vocab_size, model.num_layers
         kv = model.num_kv_heads or model.num_heads
         head_dim = d // model.num_heads
         mlp = getattr(model, "mlp_ratio", 4) * d
         per_block = d * d * 2 + d * kv * head_dim * 2 + 2 * d * mlp
-        return 4 * (v * d * 2 + l * per_block)  # f32 embed+head+blocks
+        return itemsize * (v * d * 2 + l * per_block)  # embed+head+blocks
 
     def step_seconds(self, n_active: int) -> float:
         hbm = (
