@@ -1392,6 +1392,65 @@ def bench_fleet(on_tpu, smoke=False) -> dict:
     }
 
 
+def bench_mpmd(*, naive: bool = False) -> dict:
+    """MPMD re-mesh row (``tpudml.mpmd``): the 2-stage×2-dp pipeline
+    drill — SIGKILL one stage rank mid-run, survivors drain at the
+    boundary, the planner is consulted fail-open, and the surviving
+    stage groups re-form *in place* (fresh ports, no whole-world
+    restart) resuming bit-exactly from the common checkpoint step.
+
+    ``naive=True`` adds the whole-world-restart A/B arm (peers abort on
+    peer death so every group's containment fires); both arms anchor
+    MTTR on the kill marker's mtime, so ``remesh_beats_naive`` is
+    measured, not claimed. CPU-dryrun caveat: absolute steps/s and
+    MTTRs are host-CPU numbers (gloo + TCP loopback); the *ratio* and
+    the bit-exactness verdict are the portable claims."""
+    import tempfile
+
+    from tpudml.mpmd.drill import run_mpmd_drill
+
+    base = tempfile.mkdtemp(prefix="tpudml_bench_mpmd_")
+    rep = run_mpmd_drill(base, include_naive=naive)
+    row = {
+        "bench": "mpmd_remesh_drill",
+        "ok": rep["ok"],
+        "bit_exact": rep["bit_exact"],
+        "in_place": rep["in_place"],
+        "stage_worlds": [st["dp"] for st in rep["pipeline"]["stages"]],
+        "final_stage_worlds": rep["final_stage_worlds"],
+        "steps": rep["steps"],
+        "kill_step": rep["kill_step"],
+        "resume_step": rep["resume_step"],
+        "steps_lost": rep["steps_lost"],
+        "reforms": rep["reforms"],
+        "fresh_ports": rep["fresh_ports"],
+        "remesh_mttr_s": round(rep["remesh_mttr_s"], 3)
+        if rep["remesh_mttr_s"] is not None
+        else None,
+        "replan_receipts": rep["replan_receipts"],
+        "steps_per_s": rep["steps_per_s"],
+    }
+    if naive:
+        row["naive_restart_mttr_s"] = (
+            round(rep["naive"]["restart_mttr_s"], 3)
+            if rep["naive"] and rep["naive"]["restart_mttr_s"] is not None
+            else None
+        )
+        row["remesh_beats_naive"] = rep["remesh_beats_naive"]
+    return row
+
+
+def main_mpmd() -> None:
+    """Driver for ``python bench.py --mpmd``: prints ONE JSON line, same
+    contract as ``main()``, for the MPMD pipeline re-mesh row.
+    ``--mpmd-naive`` adds the whole-world-restart A/B arm so the row
+    carries re-mesh MTTR vs restart MTTR. Requires a platform where the
+    multi-process drill can run (JAX_PLATFORMS=cpu uses gloo)."""
+    import sys
+
+    print(json.dumps(bench_mpmd(naive="--mpmd-naive" in sys.argv[1:])))
+
+
 def main_fleet() -> None:
     """Driver for ``python bench.py --fleet``: prints ONE JSON line, same
     contract as ``main()``, for the serving-fleet row (N replicas at
@@ -1489,6 +1548,8 @@ if __name__ == "__main__":
         main_serve()
     elif "--fleet" in sys.argv[1:]:
         main_fleet()
+    elif any(a.startswith("--mpmd") for a in sys.argv[1:]):
+        main_mpmd()
     elif "--sentinel" in sys.argv[1:]:
         main_sentinel()
     elif "--obs" in sys.argv[1:]:

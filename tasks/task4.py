@@ -19,6 +19,15 @@ in tests/test_mp.py.
 Run: ``python -m tasks.task4 [--n_devices 2] [--mode division]``
 (CPU-only like the reference? Not anymore — same code runs on CPU devices,
 simulated meshes, or TPU slices.)
+
+The reference's *other* defining property — each stage is its own
+process running its own program, coupled only by activation/gradient
+messages — is deliberately NOT reproduced here (GSPMD puts every stage
+in one program). That multi-program shape lives in ``tpudml/mpmd``:
+one process group per stage, host-TCP boundary transfers with the RPC
+round-trips replaced by deterministic framed p2p, and membership-aware
+re-mesh instead of whole-world restart (``python -m tpudml.mpmd
+--drill``).
 """
 
 from __future__ import annotations

@@ -297,3 +297,72 @@ def test_fleet_drill_survives_sigkill(tmp_path):
     rendered = obs_report(tmp_path)
     assert "fleet.json (serving fleet)" in rendered
     assert "merged fleet trace" in rendered
+
+
+# ----------------------------------------------- heterogeneous fleets
+
+
+def test_mixed_fleet_routes_cache_bound_to_int8(setup):
+    """One int8 + two f32 replicas under one SLO: the int8 replica's
+    cost model carries a smaller param-byte term, so cache-bound
+    head-of-line traffic is priced onto it first — routing follows the
+    honest byte accounting, not replica index order. The int8 replica
+    is deliberately the LAST index so tie-breaking cannot explain the
+    placement."""
+    from tpudml.serve import SLOConfig
+
+    model, params = setup
+    slo = SLOConfig(tpot_budget_s=1.0)  # loose: prices, never defers
+    f32 = _ecfg(slo=slo)
+    i8 = _ecfg(slo=slo, weight_quant="int8")
+    cfg = FleetConfig(engine=f32, replicas=3,
+                      replica_engines=(f32, f32, i8))
+    assert cfg.engine_for(2).weight_quant == "int8"
+    router = FleetRouter(model, params, cfg)
+    # Pricing honesty: int8 storage really is the cheaper stream.
+    assert (router.replicas[2].eng._cost.params_bytes
+            < router.replicas[0].eng._cost.params_bytes)
+    requests = _workload(6, 500.0, 3)
+    rep = FleetRouter(model, params, cfg).run(requests)
+    assert rep.finished == len(requests)
+    admits = [e for e in rep.events if e[0] == "admit"]
+    # The int8 replica (2 slots) soaks up the line first; f32 replicas
+    # only see traffic once it is full.
+    assert [e[2] for e in admits[:2]] == [2, 2]
+    assert rep.per_replica[2]["busy_slot_steps"] > 0
+
+
+def test_mixed_fleet_run_twice_byte_identical(setup):
+    model, params = setup
+    from tpudml.serve import SLOConfig
+
+    slo = SLOConfig(tpot_budget_s=1.0)
+    cfg = FleetConfig(
+        engine=_ecfg(slo=slo), replicas=2, reform_after_steps=4,
+        replica_engines=(_ecfg(slo=slo),
+                         _ecfg(slo=slo, weight_quant="int8")),
+    )
+    requests = _workload(10, 200.0, 11)
+
+    def go():
+        rep = FleetRouter(model, params, cfg).run(requests, kills=[(4, 1)])
+        return rep.canonical_events(), {
+            rid: list(st.tokens) for rid, st in rep.requests.items()
+        }
+
+    assert go() == go()
+
+
+def test_replica_engines_validation():
+    with pytest.raises(ValueError, match="entries for"):
+        FleetConfig(engine=_ecfg(), replicas=3,
+                    replica_engines=(_ecfg(), _ecfg()))
+    with pytest.raises(ValueError, match="one virtual clock"):
+        FleetConfig(engine=_ecfg(), replicas=2,
+                    replica_engines=(_ecfg(), _ecfg(step_time_s=0.02)))
+    with pytest.raises(ServeCompositionError):
+        FleetConfig(engine=_ecfg(), replicas=2,
+                    replica_engines=(_ecfg(), _ecfg(spec_k=2)))
+    # Homogeneous default: engine_for returns the template.
+    cfg = FleetConfig(engine=_ecfg(), replicas=2)
+    assert cfg.engine_for(1) is cfg.engine

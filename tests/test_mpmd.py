@@ -1,0 +1,538 @@
+"""tpudml.mpmd: stage-group topology, p2p wire contract, re-mesh
+bookkeeping, and the meshless fixture replay — all jax-free.
+
+Mirrors ``tests/test_elastic.py``'s split: controller/topology semantics
+are pinned here with pure-python structures, socketpair channels, and
+stub replanners (seconds, no backend); the e2e drill with real gloo
+worlds and SIGKILL-grade rank death lives in
+``tests/test_mpmd_pipeline.py``.
+"""
+
+import json
+import os
+import socket
+import struct
+import sys
+import threading
+import zlib
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from tpudml.comm.p2p import (
+    FRAME_MAGIC,
+    TAG_ACT,
+    DrainBarrier,
+    FramingError,
+    PeerDeadError,
+    channel_pair,
+    p2p_wire_bytes,
+    recv_frame,
+    send_frame,
+)
+from tpudml.comm.timing import collective_wire_bytes
+from tpudml.mpmd import (
+    PipelineSpec,
+    StageQuorumError,
+    StageSpec,
+    boundary_plan,
+    common_resume_step,
+    drain_marker_path,
+    drain_order,
+    read_drain_markers,
+    replace_pipeline,
+    stage_ckpt_dir,
+    warmup_microbatches,
+    write_wiring,
+)
+
+FIXTURES = Path(__file__).parent / "mpmd_fixtures"
+
+PY = sys.executable
+
+
+def _pipeline(**kw) -> PipelineSpec:
+    """The drill's canonical 2-stage×2-dp pipeline: bf16 trunk chunking
+    finer than the f32 head."""
+    d = dict(
+        stages=(
+            StageSpec("trunk", dp=2, microbatches=2, dtype="bfloat16"),
+            StageSpec("head", dp=2, microbatches=1, dtype="float32"),
+        ),
+        global_batch=8,
+    )
+    d.update(kw)
+    return PipelineSpec(**d)
+
+
+# ------------------------------------------------------------- partition
+
+
+def test_slot_layout_contiguous_per_stage():
+    p = _pipeline()
+    assert p.total_slots == 4
+    assert list(p.stage_slots(0)) == [0, 1]
+    assert list(p.stage_slots(1)) == [2, 3]
+    assert p.slot_of(1, 0) == 2
+    assert [p.locate(s) for s in range(4)] == [
+        (0, 0), (0, 1), (1, 0), (1, 1),
+    ]
+    with pytest.raises(ValueError, match="out of range"):
+        p.locate(4)
+
+
+def test_spec_validation_rejects_bad_partitions():
+    with pytest.raises(ValueError, match="duplicate stage names"):
+        _pipeline(stages=(StageSpec("a"), StageSpec("a")))
+    with pytest.raises(ValueError, match="not divisible"):
+        _pipeline(stages=(StageSpec("a", microbatches=3),), global_batch=8)
+    with pytest.raises(ValueError, match="dp=3"):
+        _pipeline(
+            stages=(StageSpec("a", dp=3, microbatches=2),), global_batch=8
+        )
+    with pytest.raises(ValueError, match="min_world"):
+        _pipeline(stages=(StageSpec("a", dp=2, min_world=3),))
+
+
+def test_capability_table_rejects_unsupported_compositions():
+    """MPMD×MoE-aux-loss, MPMD×fused-xent, MPMD×serve are table
+    rejections with the machine-readable mpmd_* messages — the planner
+    prunes them with receipts instead of discovering crashes."""
+    from tpudml.capabilities import TABLE, CompositionError
+
+    for key, kw in [
+        ("mpmd_moe_aux_loss", dict(moe_experts=4)),
+        ("mpmd_fused_xent_head", dict(fused_xent=True)),
+    ]:
+        with pytest.raises(CompositionError) as ei:
+            _pipeline(stages=(StageSpec("a", **kw), StageSpec("b")))
+        assert str(ei.value) == TABLE[key].message, key
+    with pytest.raises(CompositionError) as ei:
+        _pipeline(serve=True)
+    assert str(ei.value) == TABLE["mpmd_serve"].message
+
+
+def test_pipeline_dict_roundtrip():
+    p = _pipeline()
+    assert PipelineSpec.from_dict(p.to_dict()) == p
+    assert PipelineSpec.from_dict(
+        json.loads(json.dumps(p.to_dict()))
+    ) == p
+
+
+# ------------------------------------------------------ boundary dataflow
+
+
+def test_boundary_plan_partitions_every_global_row_once():
+    p = _pipeline()
+    plan = boundary_plan(p, 0)
+    # Contiguous cover of [0, global_batch) with no overlap, sorted.
+    assert [t.index for t in plan] == list(range(len(plan)))
+    covered = sorted(t.rows for t in plan)
+    assert covered[0][0] == 0 and covered[-1][1] == p.global_batch
+    for (_, hi), (lo, _) in zip(covered, covered[1:]):
+        assert hi == lo
+    # Both sides derive the identical list (it IS the wire schedule):
+    # the src slice and dst slice of every transfer are the same rows.
+    for t in plan:
+        slo, shi = p.row_interval(0, t.src_microbatch, t.src_rank)
+        dlo, dhi = p.row_interval(1, t.dst_microbatch, t.dst_rank)
+        assert (slo + t.src_rows[0], slo + t.src_rows[1]) == t.rows
+        assert (dlo + t.dst_rows[0], dlo + t.dst_rows[1]) == t.rows
+        assert t.edge == f"s0r{t.src_rank}->s1r{t.dst_rank}"
+    with pytest.raises(ValueError, match="no boundary"):
+        boundary_plan(p, 1)
+
+
+def test_warmup_rows_formula_heterogeneous_and_homogeneous():
+    # Hetero: trunk chunks 4×, head 2× — the homogeneous S-1-s rule
+    # would say 1, but the head's first forward needs 4 rows = 2 trunk
+    # microbatches in flight.
+    p = _pipeline(
+        stages=(
+            StageSpec("trunk", microbatches=4),
+            StageSpec("head", microbatches=2),
+        ),
+    )
+    assert warmup_microbatches(p, 0) == 2
+    assert warmup_microbatches(p, 1) == 0
+    # Homogeneous 3-stage reduces to the classic S-1-s.
+    q = PipelineSpec(
+        stages=(
+            StageSpec("a", microbatches=4),
+            StageSpec("b", microbatches=4),
+            StageSpec("c", microbatches=4),
+        ),
+        global_batch=8,
+    )
+    assert [warmup_microbatches(q, s) for s in range(3)] == [2, 1, 0]
+    with pytest.raises(ValueError, match="no stage"):
+        warmup_microbatches(q, 3)
+
+
+# ---------------------------------------------------- re-mesh bookkeeping
+
+
+def test_replace_pipeline_preserves_survivor_order():
+    p = _pipeline()
+    shrunk, slot_map = replace_pipeline(p, {3})
+    assert [st.dp for st in shrunk.stages] == [2, 1]
+    assert slot_map == {0: 0, 1: 1, 2: 2}
+    # A stage-0 death renumbers the downstream slots.
+    shrunk2, slot_map2 = replace_pipeline(p, {0})
+    assert [st.dp for st in shrunk2.stages] == [1, 2]
+    assert slot_map2 == {1: 0, 2: 1, 3: 2}
+    with pytest.raises(ValueError, match="unknown slots"):
+        replace_pipeline(p, {9})
+
+
+def test_replace_pipeline_quorum_and_divisibility():
+    p = _pipeline(
+        stages=(
+            StageSpec("trunk", dp=2, microbatches=2, min_world=2),
+            StageSpec("head", dp=2),
+        ),
+    )
+    with pytest.raises(StageQuorumError, match="min_world=2"):
+        replace_pipeline(p, {1})
+    # Survivors that no longer divide the microbatch rows are an
+    # infeasible shrink (the spec validation re-runs on construction).
+    q = _pipeline(
+        stages=(
+            StageSpec("trunk", dp=3, microbatches=3),
+            StageSpec("head", dp=1),
+        ),
+        global_batch=9,
+    )
+    with pytest.raises(ValueError, match="not divisible"):
+        replace_pipeline(q, {0})
+
+
+def test_drain_order_deepest_stage_first_victims_excluded():
+    p = _pipeline()
+    assert drain_order(p, {3}) == ((1, 0), (0, 0), (0, 1))
+    assert drain_order(p, {0}) == ((1, 0), (1, 1), (0, 1))
+
+
+# ------------------------------------------------------------ wire frames
+
+
+def test_frame_roundtrip_preserves_dtype_and_shape():
+    a, b = socket.socketpair()
+    try:
+        for arr in (
+            np.arange(12, dtype=np.float32).reshape(3, 4),
+            np.array([1, -2, 3], dtype=np.int32),
+        ):
+            send_frame(a, arr, step=7, microbatch=2, tag=TAG_ACT,
+                       edge="s0r0->s1r0")
+            out = recv_frame(b, step=7, microbatch=2, tag=TAG_ACT,
+                             edge="s0r0->s1r0")
+            assert out.dtype == arr.dtype and out.shape == arr.shape
+            np.testing.assert_array_equal(out, arr)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_framing_mismatch_keeps_stream_aligned():
+    """A mismatched frame raises FramingError AFTER consuming its
+    payload, so the next recv on the same channel still parses — the
+    error is catchable without poisoning the stream."""
+    a, b = socket.socketpair()
+    try:
+        x = np.ones((2,), np.float32)
+        send_frame(a, x, step=0, microbatch=0, tag=TAG_ACT, edge="e")
+        send_frame(a, 2 * x, step=1, microbatch=0, tag=TAG_ACT, edge="e")
+        with pytest.raises(FramingError, match="frame mismatch"):
+            recv_frame(b, step=5, microbatch=0, tag=TAG_ACT, edge="e")
+        out = recv_frame(b, step=1, microbatch=0, tag=TAG_ACT, edge="e")
+        np.testing.assert_array_equal(out, 2 * x)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_corrupt_payload_crc_is_a_framing_error():
+    a, b = socket.socketpair()
+    try:
+        payload = b"\x00" * 8
+        header = json.dumps(
+            {"v": 1, "step": 0, "microbatch": 0, "tag": TAG_ACT,
+             "edge": "e", "dtype": "float32", "shape": [2],
+             "nbytes": len(payload), "crc": zlib.crc32(payload) ^ 1},
+            sort_keys=True, separators=(",", ":"),
+        ).encode()
+        a.sendall(struct.pack("!II", FRAME_MAGIC, len(header))
+                  + header + payload)
+        with pytest.raises(FramingError, match="CRC mismatch"):
+            recv_frame(b, step=0, microbatch=0, tag=TAG_ACT, edge="e")
+    finally:
+        a.close()
+        b.close()
+
+
+def test_peer_death_is_membership_not_a_traceback():
+    ch_a, ch_b = channel_pair("s0r0->s1r0", timeout_s=5.0)
+    ch_a.close()
+    with pytest.raises(PeerDeadError) as ei:
+        ch_b.recv(step=0, microbatch=0, tag=TAG_ACT)
+    assert ei.value.edge == "s0r0->s1r0"
+    ch_b.close()
+
+
+def test_p2p_priced_in_shared_wire_model():
+    # An MPMD edge ships its payload exactly once — the "p2p" kind in
+    # the same table the planner and static analyzer score with.
+    assert p2p_wire_bytes(1024) == collective_wire_bytes("p2p", 1024, 2)
+    assert p2p_wire_bytes(1024) == 1024
+
+
+# ----------------------------------------------------------- drain barrier
+
+
+def _barrier_trio():
+    """A dp=3 stage's ctl star: hub (local rank 0) + two leaves."""
+    h1, l1 = channel_pair("ctl:s0r1", timeout_s=5.0)
+    h2, l2 = channel_pair("ctl:s0r2", timeout_s=5.0)
+    hub = DrainBarrier(hub=True, channels={1: h1, 2: h2})
+    leaf1 = DrainBarrier(hub=False, channels={1: l1})
+    leaf2 = DrainBarrier(hub=False, channels={2: l2})
+    return hub, leaf1, leaf2, (h1, h2, l1, l2)
+
+
+def _vote_all(parts, votes, step=0):
+    out = {}
+
+    def run(name, barrier, ok):
+        out[name] = barrier.vote(step, ok=ok)
+
+    ts = [
+        threading.Thread(target=run, args=(n, b, v))
+        for (n, b), v in zip(parts.items(), votes)
+    ]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=10)
+    return out
+
+
+def test_drain_barrier_unanimous_ok():
+    hub, leaf1, leaf2, chans = _barrier_trio()
+    out = _vote_all({"hub": hub, "l1": leaf1, "l2": leaf2},
+                    [True, True, True])
+    assert out == {"hub": True, "l1": True, "l2": True}
+    for c in chans:
+        c.close()
+
+
+def test_drain_barrier_single_drain_vote_vetoes_everyone():
+    hub, leaf1, leaf2, chans = _barrier_trio()
+    out = _vote_all({"hub": hub, "l1": leaf1, "l2": leaf2},
+                    [True, False, True])
+    assert out == {"hub": False, "l1": False, "l2": False}
+    for c in chans:
+        c.close()
+
+
+def test_drain_barrier_peer_death_counts_as_drain():
+    hub, leaf1, leaf2, chans = _barrier_trio()
+    # Leaf 2 dies before voting: its channel EOFs at the hub.
+    chans[3].close()
+    out = _vote_all({"hub": hub, "l1": leaf1}, [True, True])
+    assert out == {"hub": False, "l1": False}
+    for c in chans:
+        c.close()
+
+
+# ------------------------------------------------- wiring + round artifacts
+
+
+def test_wiring_document_shape(tmp_path):
+    p = _pipeline()
+    doc = write_wiring(
+        tmp_path / "wiring_r0.json", round_no=0, pipeline=p,
+        coordinator_ports=[50001, 50002],
+        boundary_ports={0: {0: 50003, 1: 50004}},
+        ctl_ports={0: 50005, 1: 50006},
+    )
+    on_disk = json.loads((tmp_path / "wiring_r0.json").read_text())
+    assert on_disk == doc
+    assert doc["version"] == 1 and doc["round"] == 0
+    assert PipelineSpec.from_dict(doc["pipeline"]) == p
+    (b,) = doc["boundaries"]
+    assert b["from"] == 0 and b["to"] == 1
+    assert sorted(b["listeners"]) == ["0", "1"]
+    assert doc["ctl"]["0"]["port"] == 50005
+
+
+def test_common_resume_step_is_the_cross_stage_intersection(tmp_path):
+    def manifest(stage, step, proc, total):
+        d = stage_ckpt_dir(tmp_path, stage) / f"step_{step}"
+        d.mkdir(parents=True, exist_ok=True)
+        (d / f"manifest_p{proc}.json").write_text(
+            json.dumps({"num_processes": total})
+        )
+
+    assert common_resume_step(tmp_path, 2) == 0
+    # Stage 0 has steps {5, 10}; stage 1 only {5}; stage 0's step 15 is
+    # manifest-incomplete (1 of 2) and must not count.
+    manifest(0, 5, 0, 2), manifest(0, 5, 1, 2)
+    manifest(0, 10, 0, 2), manifest(0, 10, 1, 2)
+    manifest(0, 15, 0, 2)
+    manifest(1, 5, 0, 1)
+    assert common_resume_step(tmp_path, 2) == 5
+    manifest(1, 10, 0, 1)
+    assert common_resume_step(tmp_path, 2) == 10
+
+
+def test_read_drain_markers_tolerates_torn_writes(tmp_path):
+    drain_marker_path(tmp_path, 0, 1).write_text(
+        json.dumps({"step": 13, "why": "peer dead"})
+    )
+    drain_marker_path(tmp_path, 1, 0).write_text("{torn")
+    out = read_drain_markers(tmp_path)
+    assert out[(0, 1)]["step"] == 13
+    assert out[(1, 0)] == {}  # torn, but the membership fact survives
+
+
+# ---------------------------------------------------------- fixture replay
+
+
+def test_fixture_replay_matches_committed_goldens():
+    """Both committed fixtures replay byte-deterministically to their
+    pinned CRCs — twice, to pin that nothing reads a clock."""
+    from tpudml.mpmd.fixture import events_crc32, replay_fixture
+
+    for name, rounds, worlds in [
+        ("steady.json", 1, [2, 2]),
+        ("shrink_stage.json", 2, [2, 1]),
+    ]:
+        a = replay_fixture(FIXTURES / name)
+        b = replay_fixture(FIXTURES / name)
+        assert a["ok"] and b["ok"], name
+        assert a["lines"] == b["lines"], name
+        assert a["events_crc32"] == a["expect_crc32"], name
+        assert events_crc32(a["lines"]) == a["events_crc32"]
+        assert a["rounds"] == rounds and a["final_stage_worlds"] == worlds
+
+
+def test_fixture_replay_fresh_ports_per_reform():
+    """Every re-form's coordinator/ctl ports are fresh — no port is
+    ever reused across rounds (the controller's bind-and-hold contract,
+    checkable in the simulated layout)."""
+    from tpudml.mpmd.fixture import replay_fixture
+
+    rep = replay_fixture(FIXTURES / "shrink_stage.json")
+    forms = [json.loads(l) for l in rep["lines"]
+             if json.loads(l).get("event") == "form"]
+    assert len(forms) == 2
+    ports = [
+        p for f in forms
+        for p in f["coordinator_ports"] + list(f["ctl_ports"].values())
+    ]
+    assert len(ports) == len(set(ports))
+    assert forms[1]["resume_step"] == 2  # the pre-kill checkpoint
+    assert forms[1]["stage_worlds"] == [2, 1]
+
+
+def test_fixture_replay_quorum_halt():
+    from tpudml.mpmd.fixture import replay_fixture
+
+    fx = {
+        "version": 1,
+        "pipeline": _pipeline(
+            stages=(
+                StageSpec("trunk", dp=2, microbatches=2, min_world=2),
+                StageSpec("head", dp=2),
+            ),
+        ).to_dict(),
+        "engines": ["dp"],
+        "events": [
+            {"type": "step", "count": 1},
+            {"type": "kill", "slot": 0},
+            {"type": "step", "count": 5},  # unreachable past the halt
+        ],
+    }
+    rep = replay_fixture(fx)
+    assert rep["halted"] == "below_stage_quorum"
+    assert rep["rounds"] == 1  # never re-formed
+    assert json.loads(rep["lines"][-1]) == {
+        "event": "halt", "reason": "below_stage_quorum",
+    }
+
+
+def test_fail_open_replan_on_vandalized_plan(tmp_path):
+    """The PR 16 contract carried into MPMD: a vandalized plan file is
+    never half-adopted, and a replanner that blows up mid-consult
+    cannot stop the re-form — the replay records the error and the
+    pipeline still shrinks in place."""
+    from tpudml.elastic.replan import Replanner
+    from tpudml.mpmd.fixture import replay_fixture
+    from tpudml.resilience.faults import PLAN_VANDALS, vandalize_plan
+
+    path = tmp_path / "plan.json"
+    Replanner(engines=["dp", "zero1"], verify=False,
+              plan_path=path).initial_plan(4)
+    vandalize_plan(str(path), next(iter(PLAN_VANDALS)))
+    assert Replanner(
+        engines=["dp", "zero1"], verify=False
+    ).load_existing(path) is None
+
+    class _Boom:
+        def initial_plan(self, world):
+            return {"world": world}
+
+        def replan(self, world, **kw):
+            raise RuntimeError("boom")
+
+    fx = json.loads((FIXTURES / "shrink_stage.json").read_text())
+    fx.pop("expect")  # the golden pins the real planner's keys
+    rep = replay_fixture(fx, replanner=_Boom())
+    assert rep["halted"] is None and rep["rounds"] == 2
+    (replan,) = [
+        json.loads(l) for l in rep["lines"]
+        if json.loads(l).get("event") == "replan"
+    ]
+    assert replan["error"] == "RuntimeError"
+    assert replan["switched"] is False
+    assert rep["final_stage_worlds"] == [2, 1]
+
+
+def test_fixture_version_gate():
+    from tpudml.mpmd.fixture import replay_fixture
+
+    bad = json.loads((FIXTURES / "steady.json").read_text())
+    bad["version"] = 9
+    with pytest.raises(ValueError, match="fixture version"):
+        replay_fixture(bad)
+
+
+def test_fixture_cli_replays_without_spawning(tmp_path):
+    """``python -m tpudml.mpmd --fixture ...`` is the meshless CI mode:
+    one process, no gang, exit code is the replay verdict."""
+    import subprocess
+
+    proc = subprocess.run(
+        [PY, "-m", "tpudml.mpmd",
+         "--fixture", str(FIXTURES / "shrink_stage.json")],
+        capture_output=True, text=True, timeout=120,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 0, proc.stderr
+    report = json.loads(proc.stdout.splitlines()[-1])
+    assert report["ok"] and report["rounds"] == 2
+    assert "[replay]" in proc.stderr  # narration goes to stderr
+    # A wrong golden flips the exit code — CI cannot rot silently.
+    bad = json.loads((FIXTURES / "steady.json").read_text())
+    bad["expect"]["events_crc32"] ^= 1
+    bad_path = tmp_path / "bad.json"
+    bad_path.write_text(json.dumps(bad))
+    proc = subprocess.run(
+        [PY, "-m", "tpudml.mpmd", "--fixture", str(bad_path)],
+        capture_output=True, text=True, timeout=120,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 1

@@ -14,7 +14,11 @@ any subset is fine; missing files just skip their section:
   ``elastic``-category instants in the exported traces;
 - ``fleet.json``     — the serving fleet's run summary (drill verdict
   rows with per-rank token CRCs + the merged per-replica trace path,
-  or a deterministic router run's membership/latency aggregates).
+  or a deterministic router run's membership/latency aggregates);
+- ``obs/mpmd.json``  — the MPMD re-mesh drill's verdict (bit-exactness
+  vs the uninterrupted reference, re-mesh vs whole-world-restart MTTR)
+  plus per-edge transfer-byte aggregates from the merged per-stage
+  trace (one pid track per stage group).
 
 Usage::
 
@@ -273,6 +277,81 @@ def fleet_summary(run_dir: Path) -> str | None:
     return "\n\n".join(out)
 
 
+def mpmd_summary(run_dir: Path) -> str | None:
+    """MPMD section: the re-mesh drill's verdict (``obs/mpmd.json``,
+    written by ``python -m tpudml.mpmd --drill``) plus per-edge boundary
+    transfer aggregates read out of the merged per-stage trace (one pid
+    per stage group, ``cat="comm"`` spans with edge-labeled bytes)."""
+    path = run_dir / "obs" / "mpmd.json"
+    if not path.is_file():
+        path = run_dir / "mpmd.json"
+    if not path.is_file():
+        return None
+    doc = json.loads(path.read_text())
+    out = []
+    victim = doc.get("victim") or {}
+    out.append(
+        f"drill: ok={doc.get('ok')}  mode={doc.get('mode', '?')}  "
+        f"bit_exact={doc.get('bit_exact')}  "
+        f"in_place={doc.get('in_place')}  "
+        f"stop_reason={doc.get('stop_reason', '?')}"
+    )
+    out.append(
+        f"re-mesh: victim=stage {victim.get('stage', '?')} rank "
+        f"{victim.get('rank', '?')} (rc {victim.get('rc', '?')})  "
+        f"final stage worlds={doc.get('final_stage_worlds')}  "
+        f"resume_step={doc.get('resume_step')}  "
+        f"steps_lost={doc.get('steps_lost')}  "
+        f"fresh_ports={doc.get('fresh_ports')}"
+    )
+    mttr = doc.get("remesh_mttr_s")
+    naive = doc.get("naive") or {}
+    line = "mttr: re-mesh-in-place "
+    line += f"{mttr:.2f}s" if mttr is not None else "-"
+    if naive.get("restart_mttr_s") is not None:
+        line += (
+            f"  whole-world-restart {naive['restart_mttr_s']:.2f}s  "
+            f"(re-mesh wins: {doc.get('remesh_beats_naive')})"
+        )
+    out.append(line)
+    sps = doc.get("steps_per_s") or {}
+    crcs = doc.get("params_crc") or {}
+    if sps:
+        rows = [
+            [k, f"{sps[k]:.2f}", crcs.get(k, "-")]
+            for k in sorted(sps)
+        ]
+        out.append(_table(["stage rank", "steps/s", "params_crc"], rows))
+    # Per-edge transfer bytes from the merged trace: sum the cat="comm"
+    # p2p spans' byte args per (pid, edge) — one row per stage track.
+    tpath = run_dir / "obs" / "trace.json"
+    if tpath.is_file():
+        try:
+            tdoc = json.loads(tpath.read_text())
+        except ValueError:
+            tdoc = {}
+        edges: dict[tuple, list] = {}
+        for e in tdoc.get("traceEvents", []):
+            if e.get("cat") != "comm" or e.get("ph") != "X":
+                continue
+            args = e.get("args") or {}
+            if "edge" not in args:
+                continue
+            key = (e.get("pid"), args["edge"], e.get("name"))
+            agg = edges.setdefault(key, [0, 0])
+            agg[0] += 1
+            agg[1] += int(args.get("bytes", 0))
+        if edges:
+            rows = [
+                [pid, edge, name, n, nbytes]
+                for (pid, edge, name), (n, nbytes) in sorted(edges.items())
+            ]
+            out.append(_table(
+                ["stage pid", "edge", "span", "frames", "bytes"], rows
+            ))
+    return "\n\n".join(out)
+
+
 def report(run_dir: str | Path) -> str:
     run_dir = Path(run_dir)
     sections = [
@@ -281,6 +360,7 @@ def report(run_dir: str | Path) -> str:
         ("obs/drift.json", drift_summary(run_dir / "obs" / "drift.json")),
         ("elastic.json (reform/re-plan)", elastic_summary(run_dir)),
         ("fleet.json (serving fleet)", fleet_summary(run_dir)),
+        ("mpmd.json (MPMD re-mesh)", mpmd_summary(run_dir)),
     ]
     out = [f"== obs report: {run_dir} =="]
     found = False

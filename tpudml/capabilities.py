@@ -26,7 +26,7 @@ fsdp_tp / pp_dp / ep``), ``mesh`` (axis-name → size dict), ``zero1``,
 ``measure_comm``, ``custom_loss``, ``aggregation``, ``dropout``,
 ``moe_experts``, ``grad_clip``, ``schedule``, ``serve_tp``,
 ``serve_cache_layout``, ``serve_spec_k``, ``serve_weight_quant``,
-``serve_fleet``.  Entries with ``when=None``
+``serve_fleet``, ``mpmd``, ``serve``.  Entries with ``when=None``
 are constructor-level invariants the planner can never generate (e.g.
 handing a pre-wrapped ZeRO1 optimizer to a non-zero1 engine) — they
 still own their runtime message here so the guard text stays in the
@@ -252,6 +252,39 @@ _ENTRIES = (
             _g(c, "serve_cache_layout", "dense") != "dense"
             or _g(c, "serve_spec_k", 0) > 0
         ),
+    ),
+    Capability(
+        key="mpmd_moe_aux_loss",
+        owner="tpudml.mpmd.spec",
+        message=(
+            "MPMD stages do not compose with moe_experts: the router "
+            "aux loss is a global mean over all tokens, and an MPMD "
+            "trunk stage has no channel to fold its aux term into the "
+            "head stage's loss"
+        ),
+        when=lambda c: bool(_g(c, "mpmd")) and bool(_g(c, "moe_experts")),
+    ),
+    Capability(
+        key="mpmd_fused_xent_head",
+        owner="tpudml.mpmd.spec",
+        message=(
+            "MPMD head stages do not compose with fused_xent: the fused "
+            "head recomputes logits inside one jitted loss+grad program, "
+            "but the MPMD head must expose the activation cotangent as a "
+            "host array for the backward wire transfer"
+        ),
+        when=lambda c: bool(_g(c, "mpmd")) and bool(_g(c, "fused_xent")),
+    ),
+    Capability(
+        key="mpmd_serve",
+        owner="tpudml.mpmd.spec",
+        message=(
+            "MPMD stage groups do not compose with the serving tier: "
+            "ServingEngine slot state lives in one process's jitted "
+            "decode step and cannot span multi-controller stage worlds; "
+            "serve from a single-program replica (FleetRouter)"
+        ),
+        when=lambda c: bool(_g(c, "mpmd")) and bool(_g(c, "serve")),
     ),
 )
 

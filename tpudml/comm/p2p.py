@@ -1,0 +1,375 @@
+"""Point-to-point activation/gradient transfer for MPMD stage groups.
+
+gloo collectives (``comm/collectives.py``) span exactly one
+``jax.distributed`` world, and an MPMD pipeline is deliberately many
+worlds — one process group per stage (``tpudml/mpmd``). The tensors
+that cross a stage boundary therefore travel OUTSIDE any jitted
+program, over plain TCP between the boundary ranks, with a framing
+contract strict enough that a resumed incarnation replays the same
+byte stream:
+
+- **deterministic (step, microbatch, edge) framing** — every frame
+  carries the training step, the wire-chunk (microbatch) index, a
+  direction tag (``act`` forward / ``grad`` backward / ``ctl`` for the
+  drain barrier) and the edge label (``s0r1->s1r0``). The receiver
+  states what it expects; any mismatch is a :class:`FramingError`
+  (a protocol bug), never silently reordered data.
+- **integrity** — payload CRC-32 per frame, verified on receipt (the
+  checkpoint layer's bit-exactness discipline applied to the wire).
+- **peer death is a membership event, not an exception trace** — EOF,
+  connection reset and receive timeout all raise :class:`PeerDeadError`
+  carrying the last good (step, microbatch); the stage loop catches it
+  and drains (``mpmd/runtime.py``).
+
+Wire pricing: an MPMD edge ships its payload exactly once, so it is
+priced as the ``"p2p"`` kind in the shared ring wire model
+(``comm/timing.py`` — same table the static analyzer and the planner
+score with): :func:`p2p_wire_bytes`. Channels feed the flight recorder
+the same way :class:`~tpudml.comm.timing.CommStats` does — one
+``cat="comm"`` complete span per frame, labeled with the edge and the
+byte count.
+
+This module is deliberately jax-free (stdlib + numpy): the MPMD
+controller and the meshless fixture replay import it without touching
+a backend. ``bfloat16`` payloads rely on ``ml_dtypes`` (jax's own
+dependency) only when such a frame is actually seen.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import time
+import zlib
+
+import numpy as np
+
+from tpudml.comm.timing import collective_wire_bytes
+
+FRAME_MAGIC = 0x4D504D44  # "MPMD"
+FRAME_VERSION = 1
+
+TAG_ACT = "act"
+TAG_GRAD = "grad"
+TAG_CTL = "ctl"
+_TAGS = (TAG_ACT, TAG_GRAD, TAG_CTL)
+
+#: Barrier verdicts (1-byte ctl payloads).
+VOTE_OK = b"\x01"
+VOTE_DRAIN = b"\x00"
+
+
+class FramingError(RuntimeError):
+    """The peer sent a frame the receiver did not expect — a protocol
+    bug (schedule divergence), distinct from peer death."""
+
+
+class PeerDeadError(RuntimeError):
+    """EOF / reset / timeout on a p2p channel: the peer (or its whole
+    stage group) is gone. Carries the last successfully framed
+    (step, microbatch) so the drain report can say what was in flight."""
+
+    def __init__(self, msg: str, *, edge: str = "?", step: int = -1,
+                 microbatch: int = -1):
+        super().__init__(msg)
+        self.edge = edge
+        self.step = step
+        self.microbatch = microbatch
+
+
+def p2p_wire_bytes(payload_bytes: int) -> float:
+    """Ring-model bytes for one MPMD edge transfer: the ``"p2p"`` kind
+    ships the payload once (``comm/timing._WIRE_MODEL``), so planner
+    dataflow rules price an MPMD edge like any other collective."""
+    return collective_wire_bytes("p2p", payload_bytes, 2)
+
+
+def _resolve_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # noqa: F401 — registers bfloat16 et al.
+
+        return np.dtype(name)
+
+
+def _recv_exact(sock: socket.socket, n: int, *, edge: str) -> bytes:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        try:
+            k = sock.recv_into(view[got:], n - got)
+        except (socket.timeout, TimeoutError) as e:
+            raise PeerDeadError(
+                f"p2p recv timeout on edge {edge}", edge=edge
+            ) from e
+        except (ConnectionResetError, BrokenPipeError, OSError) as e:
+            raise PeerDeadError(
+                f"p2p connection lost on edge {edge}: {e!r}", edge=edge
+            ) from e
+        if k == 0:
+            raise PeerDeadError(f"p2p EOF on edge {edge}", edge=edge)
+        got += k
+    return bytes(buf)
+
+
+_HDR = struct.Struct("!II")  # magic, header_len
+
+
+def send_frame(sock: socket.socket, arr: np.ndarray, *, step: int,
+               microbatch: int, tag: str, edge: str) -> int:
+    """Send one framed array; returns payload bytes on the wire."""
+    if tag not in _TAGS:
+        raise ValueError(f"unknown frame tag {tag!r}")
+    a = np.ascontiguousarray(arr)
+    payload = a.tobytes()
+    header = json.dumps(
+        {
+            "v": FRAME_VERSION,
+            "step": int(step),
+            "microbatch": int(microbatch),
+            "tag": tag,
+            "edge": edge,
+            "dtype": a.dtype.name,
+            "shape": list(a.shape),
+            "nbytes": len(payload),
+            "crc": zlib.crc32(payload),
+        },
+        sort_keys=True,
+        separators=(",", ":"),
+    ).encode()
+    try:
+        sock.sendall(_HDR.pack(FRAME_MAGIC, len(header)) + header + payload)
+    except (ConnectionResetError, BrokenPipeError, OSError) as e:
+        raise PeerDeadError(
+            f"p2p send failed on edge {edge}: {e!r}",
+            edge=edge, step=step, microbatch=microbatch,
+        ) from e
+    return len(payload)
+
+
+def recv_frame(sock: socket.socket, *, step: int, microbatch: int,
+               tag: str, edge: str) -> np.ndarray:
+    """Receive one frame, enforcing the deterministic framing: the frame
+    on the wire must carry exactly the (step, microbatch, tag, edge) the
+    caller expects."""
+    magic, hlen = _HDR.unpack(_recv_exact(sock, _HDR.size, edge=edge))
+    if magic != FRAME_MAGIC:
+        raise FramingError(
+            f"edge {edge}: bad magic {magic:#x} (expected {FRAME_MAGIC:#x})"
+        )
+    hdr = json.loads(_recv_exact(sock, hlen, edge=edge))
+    # Consume the payload before any mismatch check so the byte stream
+    # stays frame-aligned even when the error is caught.
+    payload = _recv_exact(sock, int(hdr["nbytes"]), edge=edge)
+    got = (hdr.get("step"), hdr.get("microbatch"), hdr.get("tag"),
+           hdr.get("edge"))
+    want = (int(step), int(microbatch), tag, edge)
+    if got != want:
+        raise FramingError(
+            f"frame mismatch on edge {edge}: got (step, microbatch, tag, "
+            f"edge)={got}, expected {want}"
+        )
+    if zlib.crc32(payload) != hdr["crc"]:
+        raise FramingError(
+            f"edge {edge}: payload CRC mismatch at step {step} "
+            f"microbatch {microbatch}"
+        )
+    return np.frombuffer(payload, dtype=_resolve_dtype(hdr["dtype"])).reshape(
+        hdr["shape"]
+    )
+
+
+class Channel:
+    """One full-duplex p2p connection between two boundary ranks.
+
+    Forward activations and backward gradients for the same rank pair
+    share the socket (strict alternation per the 1F1B schedule keeps the
+    turn order unambiguous). Every frame lands on the ambient tracer as
+    a ``cat="comm"`` complete span with edge-labeled byte counts — the
+    same category/args convention :class:`~tpudml.comm.timing.CommStats`
+    uses, so merged traces show MPMD edges next to in-group collectives.
+    """
+
+    def __init__(self, sock: socket.socket, edge: str, *, tracer=None,
+                 timeout_s: float | None = 60.0):
+        self.sock = sock
+        self.edge = edge
+        self.tracer = tracer
+        self.bytes_sent = 0
+        self.bytes_received = 0
+        self.frames = 0
+        if timeout_s is not None:
+            sock.settimeout(timeout_s)
+
+    def _span(self, name: str, t0: float, nbytes: int, step: int,
+              microbatch: int) -> None:
+        tracer = self.tracer
+        if tracer is None:
+            from tpudml.obs.tracer import get_tracer
+
+            tracer = get_tracer()
+        if tracer is None:
+            return
+        dur_us = int((time.perf_counter() - t0) * 1e6)
+        tracer.add_complete(
+            name, cat="comm", ts_us=max(0, tracer.now_us() - dur_us),
+            dur_us=dur_us,
+            args={
+                "edge": self.edge, "bytes": int(nbytes),
+                "wire_bytes": p2p_wire_bytes(nbytes),
+                "step": int(step), "microbatch": int(microbatch),
+            },
+        )
+
+    def send(self, arr: np.ndarray, *, step: int, microbatch: int,
+             tag: str) -> int:
+        t0 = time.perf_counter()
+        n = send_frame(self.sock, arr, step=step, microbatch=microbatch,
+                       tag=tag, edge=self.edge)
+        self.bytes_sent += n
+        self.frames += 1
+        self._span(f"p2p_send:{tag}", t0, n, step, microbatch)
+        return n
+
+    def recv(self, *, step: int, microbatch: int, tag: str) -> np.ndarray:
+        t0 = time.perf_counter()
+        arr = recv_frame(self.sock, step=step, microbatch=microbatch,
+                         tag=tag, edge=self.edge)
+        self.bytes_received += arr.nbytes
+        self.frames += 1
+        self._span(f"p2p_recv:{tag}", t0, arr.nbytes, step, microbatch)
+        return arr
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def channel_pair(edge: str, **kw) -> tuple[Channel, Channel]:
+    """An in-process full-duplex channel pair (``socket.socketpair``) —
+    the exact wire path, no listener: what the in-process pipeline tests
+    and the threaded hetero-parity harness run over."""
+    a, b = socket.socketpair()
+    return Channel(a, edge, **kw), Channel(b, edge, **kw)
+
+
+def connect_channel(host: str, port: int, *, edge: str, hello: dict,
+                    deadline_s: float = 30.0, tracer=None,
+                    timeout_s: float | None = 60.0) -> Channel:
+    """Dial a boundary listener, retrying until ``deadline_s`` (stage
+    groups start in parallel; the listener may not be up yet), then
+    introduce ourselves with a ctl hello frame carrying ``hello``."""
+    deadline = time.monotonic() + deadline_s
+    last: Exception | None = None
+    while time.monotonic() < deadline:
+        try:
+            sock = socket.create_connection((host, port), timeout=5.0)
+            break
+        except OSError as e:
+            last = e
+            time.sleep(0.05)
+    else:
+        raise PeerDeadError(
+            f"could not connect edge {edge} to {host}:{port} within "
+            f"{deadline_s:.0f}s: {last!r}",
+            edge=edge,
+        )
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    ch = Channel(sock, edge, tracer=tracer, timeout_s=timeout_s)
+    payload = np.frombuffer(
+        json.dumps(hello, sort_keys=True).encode(), np.uint8
+    )
+    send_frame(sock, payload, step=0, microbatch=0, tag=TAG_CTL, edge=edge)
+    return ch
+
+
+def accept_channels(listener: socket.socket, n: int, *,
+                    deadline_s: float = 30.0, tracer=None,
+                    timeout_s: float | None = 60.0) -> dict[str, tuple[Channel, dict]]:
+    """Accept ``n`` dialers on an already-bound listener; returns
+    ``edge -> (channel, hello)`` keyed by each hello frame's edge."""
+    listener.settimeout(deadline_s)
+    out: dict[str, tuple[Channel, dict]] = {}
+    for _ in range(n):
+        try:
+            sock, _addr = listener.accept()
+        except (socket.timeout, TimeoutError) as e:
+            raise PeerDeadError(
+                f"listener timed out waiting for {n} peers "
+                f"(got {len(out)})"
+            ) from e
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock.settimeout(deadline_s)
+        # The hello's edge is unknown until read: accept any edge label.
+        magic, hlen = _HDR.unpack(_recv_exact(sock, _HDR.size, edge="hello"))
+        if magic != FRAME_MAGIC:
+            raise FramingError(f"hello: bad magic {magic:#x}")
+        hdr = json.loads(_recv_exact(sock, hlen, edge="hello"))
+        if hdr.get("tag") != TAG_CTL:
+            raise FramingError(f"hello must be a ctl frame, got {hdr!r}")
+        payload = _recv_exact(sock, int(hdr["nbytes"]), edge="hello")
+        if zlib.crc32(payload) != hdr["crc"]:
+            raise FramingError("hello payload CRC mismatch")
+        hello = json.loads(bytes(payload))
+        edge = hdr["edge"]
+        out[edge] = (Channel(sock, edge, tracer=tracer,
+                             timeout_s=timeout_s), hello)
+    return out
+
+
+class DrainBarrier:
+    """Step-boundary consensus inside one stage group, over ctl frames.
+
+    Why it exists: the step-end gradient psum is a gloo collective —
+    a rank that enters it while a peer has already drained (its boundary
+    socket died first) hangs until the job timeout. So before every
+    collective the group votes over a host-level star (stage-local rank
+    0 is the hub): each leaf sends ``ok``/``drain``, the hub broadcasts
+    the AND. A rank only enters the psum after a unanimous ``ok`` — and
+    a rank that voted ok is committed to enter it, so the collective can
+    never half-start. Peer death during the vote counts as ``drain``
+    (the whole point: the dead stage's EOF propagates through the
+    surviving group at a step boundary, in deterministic drain order).
+    """
+
+    def __init__(self, *, hub: bool, channels: dict[int, Channel]):
+        self.hub = hub
+        self.channels = dict(channels)  # peer local-rank -> Channel
+
+    def vote(self, step: int, *, ok: bool = True) -> bool:
+        """True iff every rank in the group voted ok this step."""
+        mine = VOTE_OK if ok else VOTE_DRAIN
+        verdict = ok
+        if self.hub:
+            for rank in sorted(self.channels):
+                ch = self.channels[rank]
+                try:
+                    token = ch.recv(step=step, microbatch=rank, tag=TAG_CTL)
+                    if bytes(token.tobytes()) != VOTE_OK:
+                        verdict = False
+                except PeerDeadError:
+                    verdict = False
+            out = VOTE_OK if verdict else VOTE_DRAIN
+            for rank in sorted(self.channels):
+                try:
+                    self.channels[rank].send(
+                        np.frombuffer(out, np.uint8), step=step,
+                        microbatch=rank, tag=TAG_CTL,
+                    )
+                except PeerDeadError:
+                    pass  # a peer that died mid-broadcast is draining anyway
+            return verdict
+        # Leaf: exactly one channel (to the hub).
+        ((rank, ch),) = self.channels.items()
+        try:
+            ch.send(np.frombuffer(mine, np.uint8), step=step,
+                    microbatch=rank, tag=TAG_CTL)
+            token = ch.recv(step=step, microbatch=rank, tag=TAG_CTL)
+        except PeerDeadError:
+            return False
+        return ok and bytes(token.tobytes()) == VOTE_OK

@@ -49,6 +49,9 @@ _WIRE_MODEL = {
     "all_to_all": lambda p, n: p * (n - 1) / n,
     "pgather": lambda p, n: p * (n - 1) / n,
     "ppermute": lambda p, n: float(p),
+    # MPMD stage-boundary edge (comm/p2p.py): the activation/gradient
+    # payload crosses the wire exactly once, sender to receiver.
+    "p2p": lambda p, n: float(p),
 }
 
 

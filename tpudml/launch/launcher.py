@@ -144,6 +144,19 @@ def launch(
     return result
 
 
+def launch_once(
+    cmd: list[str],
+    spec: ClusterSpec,
+    sink=None,
+) -> LaunchResult:
+    """Single-attempt launch — the containment core without the restart
+    loop. This is the primitive the multi-gang supervisors build rounds
+    from: ``tpudml.elastic`` runs one per incarnation, ``tpudml.mpmd``
+    runs one per *stage group* concurrently (each stage is its own gloo
+    world with its own rendezvous)."""
+    return _launch_once(cmd, spec, sink)
+
+
 def _launch_once(
     cmd: list[str],
     spec: ClusterSpec,

@@ -1,0 +1,68 @@
+"""MPMD pipeline runtime: multi-controller stage groups with
+point-to-point transfer and re-mesh-in-place.
+
+One ``jax.distributed`` world cannot span programs that differ in code,
+precision, or schedule — so an MPMD pipeline (arXiv 2412.14374) runs S
+*independent* gloo worlds, one per stage, agreeing only on a wire
+contract:
+
+- ``tpudml.mpmd.spec`` — the jax-free topology layer: stage partition,
+  deterministic boundary transfer plans, heterogeneous 1F1B warmup
+  depths, re-mesh bookkeeping (quorum, drain order);
+- ``tpudml.comm.p2p`` — the boundary channel: (step, microbatch, edge)
+  framed tensors over TCP, priced in the shared ring wire model, plus
+  the intra-stage drain barrier;
+- ``tpudml.mpmd.runtime`` — per-stage programs (own microbatch count,
+  own compute dtype, f32 master params) and the 1F1B host loop;
+- ``tpudml.mpmd.groups`` — :class:`MPMDController`: forms every stage
+  group on fresh ports per round, supervises them concurrently, and on
+  rank death drains survivors, consults the PR 16 planner fail-open,
+  and re-forms the shrunken pipeline *in place* from the common
+  checkpoint step — no whole-world restart;
+- ``tpudml.mpmd.drill`` / ``tpudml.mpmd.fixture`` — the e2e kill drill
+  (CRC bit-exactness vs an uninterrupted reference) and the meshless
+  membership/transfer event replay that keeps the semantics in tier-1.
+
+Only the jax-free layers are imported eagerly; ``runtime`` and
+``drill`` pull in jax on first use.
+"""
+
+from tpudml.mpmd.groups import (
+    MPMDController,
+    MPMDReformRecord,
+    MPMDResult,
+    common_resume_step,
+    drain_marker_path,
+    read_drain_markers,
+    stage_ckpt_dir,
+    write_wiring,
+)
+from tpudml.mpmd.spec import (
+    PipelineSpec,
+    StageQuorumError,
+    StageSpec,
+    Transfer,
+    boundary_plan,
+    drain_order,
+    replace_pipeline,
+    warmup_microbatches,
+)
+
+__all__ = [
+    "MPMDController",
+    "MPMDReformRecord",
+    "MPMDResult",
+    "PipelineSpec",
+    "StageQuorumError",
+    "StageSpec",
+    "Transfer",
+    "boundary_plan",
+    "common_resume_step",
+    "drain_marker_path",
+    "drain_order",
+    "read_drain_markers",
+    "replace_pipeline",
+    "stage_ckpt_dir",
+    "warmup_microbatches",
+    "write_wiring",
+]

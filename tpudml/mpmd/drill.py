@@ -1,0 +1,730 @@
+"""The MPMD re-mesh drill: SIGKILL one stage rank → drain → re-mesh →
+bit-exact resume.
+
+Mirrors ``tpudml/elastic/drill.py`` one level up the stack:
+
+- :func:`child_main` — one rank of one *stage group*
+  (``python -m tpudml.mpmd.drill``): reads the round's wiring file,
+  forms its stage's gloo world (its own coordinator — ``jax.distributed``
+  never spans stages), dials/accepts the boundary p2p channels and the
+  intra-stage drain-barrier star, and runs the heterogeneous 1F1B
+  schedule (:class:`~tpudml.mpmd.runtime.StageWorker`). Batches are a
+  pure function of the step index, per-stage sharded CRC-verified
+  checkpoints land every k steps, and a peer death drains the rank
+  cleanly at the step boundary: marker file + rc 0 (so the controller's
+  victim attribution stays unambiguous). ``--drain_mode abort`` is the
+  *naive* arm: peer death exits rc 75 immediately, which trips every
+  group's containment — the measured whole-world-restart baseline.
+
+- :func:`run_mpmd_drill` — the e2e evidence: a 2-stage×2-dp pipeline
+  (bf16 trunk with 2 microbatches feeding an f32 head with 1 — the
+  heterogeneity is in the drill, not just the unit tests), one head
+  rank SIGKILLed mid-training, surviving groups drain, the planner is
+  consulted fail-open, the pipeline re-forms in place (trunk keeps its
+  world; only the victim stage shrinks), and the continued run must be
+  CRC-identical per surviving (stage, rank) to an uninterrupted
+  reference run of the re-meshed configuration started from a pristine
+  copy of the same checkpoint. MTTR is anchored on the kill marker's
+  mtime (the failure instant) → the last rank's resume print, so the
+  in-place and naive arms are compared on the same clock.
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import os
+import re
+import shutil
+import signal
+import socket as socketlib
+import sys
+import time
+import zlib
+from pathlib import Path
+
+import numpy as np
+
+# --------------------------------------------------------------- child
+
+
+def _params_crc(tree) -> int:
+    """CRC-32 over the concatenated little-endian bytes of every leaf in
+    ``jax.tree.leaves`` order — the elastic drill's bit-exactness
+    witness, reused verbatim."""
+    import jax
+
+    crc = 0
+    for leaf in jax.tree.leaves(tree):
+        crc = zlib.crc32(np.ascontiguousarray(np.asarray(leaf)).tobytes(), crc)
+    return crc
+
+
+def child_main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tpudml.mpmd.drill")
+    ap.add_argument("--stage", type=int, required=True)
+    ap.add_argument("--wiring", type=str, required=True)
+    ap.add_argument("--round_dir", type=str, required=True)
+    ap.add_argument("--resume_step", type=int, default=0)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--ckpt_dir", type=str, required=True)
+    ap.add_argument("--ckpt_every", type=int, default=5)
+    ap.add_argument("--feature_dim", type=int, default=8)
+    ap.add_argument("--hidden", type=str, default="16")
+    ap.add_argument("--classes", type=int, default=4)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--momentum", type=float, default=0.9)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--kill_step", type=int, default=-1)
+    ap.add_argument("--kill_stage", type=int, default=-1)
+    ap.add_argument("--kill_rank", type=int, default=1)
+    ap.add_argument("--kill_marker", type=str, default=None)
+    ap.add_argument("--drain_mode", type=str, default="drain",
+                    choices=("drain", "abort"))
+    ap.add_argument("--obs_dir", type=str, default=None)
+    args = ap.parse_args(argv)
+
+    from tpudml.checkpoint.sharded import (
+        restore_sharded_checkpoint,
+        save_sharded_checkpoint,
+    )
+    from tpudml.comm.p2p import (
+        DrainBarrier,
+        accept_channels,
+        connect_channel,
+    )
+    from tpudml.core.config import DistributedConfig
+    from tpudml.core.dist import distributed_init
+    from tpudml.mpmd.groups import drain_marker_path, stage_ckpt_dir
+    from tpudml.mpmd.runtime import (
+        DrainSignal,
+        GroupReducer,
+        StageProgram,
+        StageWorker,
+        make_batch_fn,
+        stage_layer_dims,
+    )
+    from tpudml.mpmd.spec import PipelineSpec, boundary_plan
+    from tpudml.obs.tracer import Tracer, set_tracer
+    from tpudml.resilience.faults import rank_kill_hook
+
+    wiring = json.loads(Path(args.wiring).read_text())
+    if wiring.get("version") != 1:
+        raise SystemExit(f"unsupported wiring version {wiring.get('version')}")
+    spec = PipelineSpec.from_dict(wiring["pipeline"])
+    stage = args.stage
+    st = spec.stages[stage]
+    rank = int(os.environ.get("TPUDML_PROCESS_ID", "0"))
+    round_no = int(os.environ.get("TPUDML_MPMD_ROUND", wiring["round"]))
+    hidden = tuple(int(h) for h in args.hidden.split(",") if h)
+    n_stages = len(spec.stages)
+
+    if st.dp > 1:
+        distributed_init(DistributedConfig.from_env())
+
+    # The drain marker must be written even when this rank is torn down
+    # by its group's containment (the victim's peers get SIGTERM before
+    # they observe the death themselves): a drained rank ALWAYS exits 0
+    # with a marker, so the controller can tell victims from survivors.
+    # Installed after distributed_init so it wins over jax's handler.
+    state = {"step": args.resume_step}
+
+    def _drain_and_exit(signum, frame):
+        try:
+            drain_marker_path(args.round_dir, stage, rank).write_text(
+                json.dumps({"step": state["step"], "why": "sigterm",
+                            "round": round_no}) + "\n"
+            )
+        except OSError:
+            pass
+        os._exit(0)
+
+    signal.signal(signal.SIGTERM, _drain_and_exit)
+
+    tracer = Tracer()
+    set_tracer(tracer)
+
+    program = StageProgram(
+        spec, stage, feature_dim=args.feature_dim, hidden=hidden,
+        classes=args.classes, seed=args.seed, lr=args.lr,
+        momentum=args.momentum,
+    )
+    batch_for = make_batch_fn(
+        spec.global_batch, args.feature_dim, args.classes, args.seed
+    )
+
+    # Resume from the controller-designated common step: the exact-step
+    # CRC-verified restore (not newest-valid — stages must agree).
+    if args.resume_step > 0:
+        target = {
+            "mom": program.momentum,
+            "params": program.params,
+            "step": np.zeros((), np.int64),
+        }
+        restored = restore_sharded_checkpoint(
+            stage_ckpt_dir(args.ckpt_dir, stage) / f"step_{args.resume_step}",
+            target,
+            verify=True,
+        )
+        program.params = restored["params"]
+        program.momentum = restored["mom"]
+        print(
+            f"[mpmd] stage {stage} rank {rank} resumed step "
+            f"{args.resume_step} wall {time.time():.3f}",
+            flush=True,
+        )
+        tracer.instant(
+            "mpmd_resume", cat="mpmd",
+            args={"stage": stage, "rank": rank, "step": args.resume_step},
+        )
+
+    # ------------------------------------------------ wire the topology
+    host = wiring["host"]
+    deadline_s = 60.0
+    listeners = []
+    up_listener = None
+    if stage > 0:
+        b = wiring["boundaries"][stage - 1]
+        port = b["listeners"][str(rank)]["port"]
+        up_listener = socketlib.socket(socketlib.AF_INET,
+                                       socketlib.SOCK_STREAM)
+        up_listener.setsockopt(socketlib.SOL_SOCKET,
+                               socketlib.SO_REUSEADDR, 1)
+        up_listener.bind((host, port))
+        n_up = len({
+            t.src_rank for t in boundary_plan(spec, stage - 1)
+            if t.dst_rank == rank
+        })
+        up_listener.listen(n_up)
+        listeners.append(up_listener)
+    ctl_listener = None
+    if st.dp > 1 and rank == 0:
+        port = wiring["ctl"][str(stage)]["port"]
+        ctl_listener = socketlib.socket(socketlib.AF_INET,
+                                        socketlib.SOCK_STREAM)
+        ctl_listener.setsockopt(socketlib.SOL_SOCKET,
+                                socketlib.SO_REUSEADDR, 1)
+        ctl_listener.bind((host, port))
+        ctl_listener.listen(st.dp - 1)
+        listeners.append(ctl_listener)
+
+    down_channels = {}
+    if stage < n_stages - 1:
+        b = wiring["boundaries"][stage]
+        for q in sorted({
+            t.dst_rank for t in boundary_plan(spec, stage)
+            if t.src_rank == rank
+        }):
+            edge = f"s{stage}r{rank}->s{stage + 1}r{q}"
+            down_channels[edge] = connect_channel(
+                b["listeners"][str(q)]["host"],
+                b["listeners"][str(q)]["port"],
+                edge=edge,
+                hello={"stage": stage, "rank": rank, "edge": edge},
+                deadline_s=deadline_s,
+                tracer=tracer,
+            )
+    barrier = None
+    if st.dp > 1 and rank != 0:
+        edge = f"ctl:s{stage}r{rank}"
+        ch = connect_channel(
+            wiring["ctl"][str(stage)]["host"],
+            wiring["ctl"][str(stage)]["port"],
+            edge=edge,
+            hello={"stage": stage, "rank": rank, "edge": edge},
+            deadline_s=deadline_s,
+            tracer=tracer,
+        )
+        barrier = DrainBarrier(hub=False, channels={rank: ch})
+
+    up_channels = {}
+    if up_listener is not None:
+        accepted = accept_channels(
+            up_listener, n_up, deadline_s=deadline_s, tracer=tracer
+        )
+        up_channels = {edge: ch for edge, (ch, _hello) in accepted.items()}
+    if ctl_listener is not None:
+        accepted = accept_channels(
+            ctl_listener, st.dp - 1, deadline_s=deadline_s, tracer=tracer
+        )
+        barrier = DrainBarrier(
+            hub=True,
+            channels={
+                int(hello["rank"]): ch
+                for _edge, (ch, hello) in accepted.items()
+            },
+        )
+
+    up_features = (
+        stage_layer_dims(args.feature_dim, hidden, args.classes,
+                         n_stages)[stage - 1][-1][1]
+        if stage > 0
+        else None
+    )
+    worker = StageWorker(
+        spec, stage, rank,
+        program=program,
+        batch_for=batch_for,
+        up_features=up_features,
+        up_channels=up_channels,
+        down_channels=down_channels,
+        barrier=barrier,
+        reducer=GroupReducer(st.dp),
+    )
+
+    kill = None
+    if args.kill_step >= 0 and args.kill_stage == stage:
+        kill = rank_kill_hook(
+            args.kill_step, marker=args.kill_marker, rank=args.kill_rank
+        )
+
+    loss = float("nan")
+    drained_at = None
+    t_loop = time.perf_counter()
+    final_step = args.steps
+    for step in range(args.resume_step, args.steps):
+        state["step"] = step
+        if kill is not None:
+            kill(step=step)
+        try:
+            with tracer.span("mpmd_step", cat="step",
+                             args={"step": step, "stage": stage}):
+                loss = worker.run_step(step)
+        except DrainSignal as e:
+            drained_at = e.step
+            final_step = e.step
+            if args.drain_mode == "abort":
+                # Naive arm: no cooperative drain — die loudly so every
+                # group's containment tears the whole world down.
+                print(
+                    f"[mpmd] stage {stage} rank {rank} aborted step "
+                    f"{e.step} ({e.why})",
+                    flush=True,
+                )
+                return 75
+            drain_marker_path(args.round_dir, stage, rank).write_text(
+                json.dumps({"step": e.step, "why": e.why,
+                            "round": round_no}) + "\n"
+            )
+            print(
+                f"[mpmd] stage {stage} rank {rank} drained step {e.step} "
+                f"({e.why})",
+                flush=True,
+            )
+            tracer.instant(
+                "mpmd_drain", cat="mpmd",
+                args={"stage": stage, "rank": rank, "step": e.step,
+                      "why": e.why},
+            )
+            break
+        if args.ckpt_every and (step + 1) % args.ckpt_every == 0:
+            with tracer.span("mpmd_checkpoint", cat="ckpt",
+                             args={"step": step + 1, "stage": stage}):
+                save_sharded_checkpoint(
+                    stage_ckpt_dir(args.ckpt_dir, stage),
+                    {
+                        "mom": program.momentum,
+                        "params": program.params,
+                        "step": np.int64(step + 1),
+                    },
+                    step + 1,
+                )
+    wall = time.perf_counter() - t_loop
+    executed = max(0, final_step - args.resume_step)
+    sps = executed / wall if wall > 0 else 0.0
+
+    crc = _params_crc(program.params)
+    loss_crc = zlib.crc32(
+        np.asarray(worker.losses, np.float32).tobytes()
+    )
+    print(
+        f"[mpmd] stage {stage} rank {rank} world {st.dp} dtype {st.dtype} "
+        f"mb {st.microbatches} final_step {final_step} "
+        f"loss {float(loss):.6f} params_crc {crc:08x} "
+        f"loss_crc {loss_crc:08x} steps_per_s {sps:.3f}",
+        flush=True,
+    )
+    for ch in (*up_channels.values(), *down_channels.values()):
+        ch.close()
+    if args.obs_dir:
+        tracer.export(
+            Path(args.obs_dir) / f"trace_s{stage}_p{rank}.json"
+        )
+    return 0
+
+
+# --------------------------------------------------------------- driver
+
+_FINAL_RE = re.compile(
+    r"\[mpmd\] stage (\d+) rank (\d+) world (\d+) dtype (\S+) mb (\d+) "
+    r"final_step (\d+) loss [-0-9.einfa]+ params_crc ([0-9a-f]{8}) "
+    r"loss_crc ([0-9a-f]{8}) steps_per_s ([0-9.]+)"
+)
+_RESUME_RE = re.compile(
+    r"\[mpmd\] stage (\d+) rank (\d+) resumed step (\d+) wall ([0-9.]+)"
+)
+_DRAIN_RE = re.compile(
+    r"\[mpmd\] stage (\d+) rank (\d+) drained step (\d+)"
+)
+
+
+def _parse_finals(log: str) -> dict:
+    """(stage, rank) → the final-line evidence record; later lines (the
+    re-meshed incarnation) overwrite earlier ones."""
+    out = {}
+    for m in _FINAL_RE.finditer(log):
+        out[(int(m.group(1)), int(m.group(2)))] = {
+            "world": int(m.group(3)),
+            "dtype": m.group(4),
+            "microbatches": int(m.group(5)),
+            "final_step": int(m.group(6)),
+            "params_crc": m.group(7),
+            "loss_crc": m.group(8),
+            "steps_per_s": float(m.group(9)),
+        }
+    return out
+
+
+def _parse_resumes(log: str) -> list:
+    return [
+        (int(m.group(1)), int(m.group(2)), int(m.group(3)),
+         float(m.group(4)))
+        for m in _RESUME_RE.finditer(log)
+    ]
+
+
+def _parse_drains(log: str) -> list:
+    return [
+        (int(m.group(1)), int(m.group(2)), int(m.group(3)))
+        for m in _DRAIN_RE.finditer(log)
+    ]
+
+
+def _copy_stage_ckpts(src_ckpt: Path, step: int, dst_ckpt: Path,
+                      n_stages: int) -> None:
+    """Pristine per-stage copies of one common step — the restore point
+    the reference/naive arms start from."""
+    for s in range(n_stages):
+        src = Path(src_ckpt) / f"stage{s}" / f"step_{step}"
+        dst = Path(dst_ckpt) / f"stage{s}" / f"step_{step}"
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copytree(src, dst)
+
+
+def _drill_pipeline(global_batch: int = 8):
+    from tpudml.mpmd.spec import PipelineSpec, StageSpec
+
+    return PipelineSpec(
+        stages=(
+            StageSpec("trunk", dp=2, microbatches=2, dtype="bfloat16"),
+            StageSpec("head", dp=2, microbatches=1, dtype="float32"),
+        ),
+        global_batch=global_batch,
+    )
+
+
+def _merge_stage_traces(obs_dir: Path, n_stages: int, controller_doc=None):
+    """One pid track per stage group: the stage leaders' (local rank 0)
+    exported docs re-pidded to the stage index, plus the controller's
+    track at pid ``n_stages``. Returns (merged_doc_or_None, pids)."""
+    from tpudml.obs.tracer import merge_chrome_traces, validate_chrome_trace
+
+    docs = []
+    for s in range(n_stages):
+        p = Path(obs_dir) / f"trace_s{s}_p0.json"
+        if not p.is_file():
+            return None, []
+        doc = json.loads(p.read_text())
+        for e in doc.get("traceEvents", []):
+            e["pid"] = s
+            if e.get("ph") == "M" and e.get("name") == "process_name":
+                e["args"] = {"name": f"mpmd stage {s}"}
+        docs.append(doc)
+    if controller_doc is not None:
+        for e in controller_doc.get("traceEvents", []):
+            e["pid"] = n_stages
+            if e.get("ph") == "M" and e.get("name") == "process_name":
+                e["args"] = {"name": "mpmd controller"}
+        docs.append(controller_doc)
+    try:
+        merged = merge_chrome_traces(docs)
+        validate_chrome_trace(merged)
+    except ValueError:
+        return None, []
+    pids = sorted({e["pid"] for e in merged["traceEvents"]
+                   if e["ph"] != "M"})
+    return merged, pids
+
+
+def run_mpmd_drill(
+    base_dir: str,
+    *,
+    steps: int = 20,
+    ckpt_every: int = 5,
+    kill_step: int = 13,
+    kill_stage: int = 1,
+    kill_rank: int = 1,
+    backoff_s: float = 0.25,
+    timeout_s: float = 600.0,
+    seed: int = 0,
+    include_naive: bool = False,
+    sink=None,
+) -> dict:
+    """The full re-mesh drill; returns the evidence dict the CLI / tests
+    gate on (``ok``)."""
+    from tpudml.elastic.replan import Replanner
+    from tpudml.launch.cluster import ClusterSpec
+    from tpudml.mpmd.groups import MPMDController, _Tee
+    from tpudml.mpmd.spec import PipelineSpec
+    from tpudml.obs.tracer import Tracer, set_tracer
+    from tpudml.plan.space import flagship_lm
+
+    base = Path(base_dir)
+    base.mkdir(parents=True, exist_ok=True)
+    obs_dir = base / "obs"
+    obs_dir.mkdir(parents=True, exist_ok=True)
+    pipeline = _drill_pipeline()
+    n_stages = len(pipeline.stages)
+    plan_path = base / "plan.json"
+    ckpt_dir = base / "ckpt"
+    marker = base / "kill.marker"
+
+    tracer = Tracer()
+    prev_tracer = set_tracer(tracer)
+    try:
+        rp = Replanner(
+            flagship_lm(), engines=["dp", "zero1"], verify=False,
+            plan_path=plan_path,
+        )
+        rp.initial_plan(pipeline.total_slots)
+
+        child = [
+            sys.executable, "-u", "-m", "tpudml.mpmd.drill",
+            "--steps", str(steps),
+            "--ckpt_every", str(ckpt_every),
+            "--ckpt_dir", str(ckpt_dir),
+            "--seed", str(seed),
+            "--obs_dir", str(obs_dir),
+            "--kill_step", str(kill_step),
+            "--kill_stage", str(kill_stage),
+            "--kill_rank", str(kill_rank),
+            "--kill_marker", str(marker),
+        ]
+        spec = ClusterSpec(
+            num_processes=2,  # overwritten per stage
+            timeout_s=timeout_s,
+            grace_s=3.0,
+            restart_backoff_s=backoff_s,
+            restart_backoff_jitter=0.5,
+            restart_backoff_seed=seed,
+        )
+        drill_log = io.StringIO()
+        ctrl = MPMDController(
+            child, pipeline, spec,
+            run_dir=base / "run",
+            ckpt_dir=ckpt_dir,
+            max_reforms=2,
+            replanner=rp,
+            sink=_Tee(drill_log, sink),
+        )
+        mres = ctrl.run()
+        log = drill_log.getvalue()
+        finals = _parse_finals(log)
+        resumes = _parse_resumes(log)
+        drains = _parse_drains(log)
+        (obs_dir / "mpmd_elastic.json").write_text(
+            json.dumps(mres.to_dict(), indent=2, sort_keys=True) + "\n"
+        )
+
+        resume_step = min((s for _, _, s, _ in resumes), default=None)
+        kill_wall = marker.stat().st_mtime if marker.is_file() else None
+        remesh_mttr = (
+            max(w for _, _, _, w in resumes) - kill_wall
+            if resumes and kill_wall is not None
+            else None
+        )
+        final_pipeline = (
+            PipelineSpec.from_dict(mres.records[-1].pipeline)
+            if mres.records else None
+        )
+
+        # Reference arm: the re-meshed configuration, uninterrupted, from
+        # a pristine copy of the same checkpoint — per-(stage, rank) CRC
+        # comparison is the bit-exactness verdict.
+        bit_exact = False
+        ref_finals = {}
+        if (
+            mres.success
+            and resume_step is not None
+            and final_pipeline is not None
+        ):
+            _copy_stage_ckpts(ckpt_dir, resume_step, base / "ref_ckpt",
+                              n_stages)
+            ref_obs = base / "ref_obs"
+            ref_child = [
+                sys.executable, "-u", "-m", "tpudml.mpmd.drill",
+                "--steps", str(steps),
+                "--ckpt_every", "0",
+                "--ckpt_dir", str(base / "ref_ckpt"),
+                "--seed", str(seed),
+                "--obs_dir", str(ref_obs),
+            ]
+            ref_log = io.StringIO()
+            ref_ctrl = MPMDController(
+                ref_child, final_pipeline, spec,
+                run_dir=base / "ref_run",
+                ckpt_dir=base / "ref_ckpt",
+                max_reforms=0,
+                sink=_Tee(ref_log, sink),
+            )
+            ref_res = ref_ctrl.run()
+            ref_finals = _parse_finals(ref_log.getvalue())
+            bit_exact = (
+                ref_res.success
+                and set(ref_finals) == set(finals)
+                and all(
+                    finals[k]["params_crc"] == ref_finals[k]["params_crc"]
+                    and finals[k]["loss_crc"] == ref_finals[k]["loss_crc"]
+                    for k in ref_finals
+                )
+            )
+
+        # Naive A/B arm: same kill, but peers abort instead of draining —
+        # every group's containment fires and the whole world restarts.
+        naive = None
+        if include_naive:
+            naive_ckpt = base / "naive_ckpt"
+            naive_marker = base / "naive_kill.marker"
+            naive_child = [
+                sys.executable, "-u", "-m", "tpudml.mpmd.drill",
+                "--steps", str(steps),
+                "--ckpt_every", str(ckpt_every),
+                "--ckpt_dir", str(naive_ckpt),
+                "--seed", str(seed),
+                "--obs_dir", str(base / "naive_obs"),
+                "--kill_step", str(kill_step),
+                "--kill_stage", str(kill_stage),
+                "--kill_rank", str(kill_rank),
+                "--kill_marker", str(naive_marker),
+                "--drain_mode", "abort",
+            ]
+            naive_log = io.StringIO()
+            naive_ctrl = MPMDController(
+                naive_child, pipeline, spec,
+                run_dir=base / "naive_run",
+                ckpt_dir=naive_ckpt,
+                max_reforms=2,
+                victim_rc=17,
+                sink=_Tee(naive_log, sink),
+            )
+            naive_res = naive_ctrl.run()
+            naive_resumes = _parse_resumes(naive_log.getvalue())
+            naive_kill_wall = (
+                naive_marker.stat().st_mtime
+                if naive_marker.is_file() else None
+            )
+            naive_mttr = (
+                max(w for _, _, _, w in naive_resumes) - naive_kill_wall
+                if naive_resumes and naive_kill_wall is not None
+                else None
+            )
+            naive = {
+                "success": naive_res.success,
+                "reforms": naive_res.reforms,
+                "restart_mttr_s": naive_mttr,
+                "resume_step": min(
+                    (s for _, _, s, _ in naive_resumes), default=None
+                ),
+            }
+
+        # Trace evidence: one pid per stage group + the controller track.
+        tracer_doc = tracer.chrome_trace()
+        merged, pids = _merge_stage_traces(obs_dir, n_stages, tracer_doc)
+        if merged is not None:
+            (obs_dir / "trace.json").write_text(
+                json.dumps(merged, sort_keys=True, separators=(",", ":"))
+                + "\n"
+            )
+
+        ports = [p for r in mres.records for p in r.coordinator_ports]
+        replan = mres.replans[0] if mres.replans else None
+        receipts = list(replan.get("receipts", [])) if replan else []
+        in_place = (
+            len(mres.records) == 2
+            and mres.records[0].stage_worlds == [2, 2]
+            and mres.records[1].stage_worlds
+            == [2 if s != kill_stage else 1 for s in range(n_stages)]
+        )
+        victim = mres.records[0].victim if mres.records else None
+        ok = (
+            mres.success
+            and mres.reforms == 1
+            and in_place
+            and victim is not None
+            and victim["stage"] == kill_stage
+            and victim["rank"] == kill_rank
+            and replan is not None
+            and not replan.get("error")
+            and bool(receipts)
+            and resume_step is not None
+            and kill_step - resume_step >= 0
+            and bool(drains)
+            and bit_exact
+            and len(set(ports)) == len(ports)
+            and merged is not None
+            and pids == list(range(n_stages + 1))
+        )
+        result = {
+            "ok": ok,
+            "mode": "mpmd_remesh",
+            "bit_exact": bit_exact,
+            "pipeline": pipeline.to_dict(),
+            "final_stage_worlds": mres.final_stage_worlds,
+            "in_place": in_place,
+            "steps": steps,
+            "kill_step": kill_step,
+            "kill_stage": kill_stage,
+            "kill_rank": kill_rank,
+            "victim": victim,
+            "drains": drains,
+            "resume_step": resume_step,
+            "steps_lost": (
+                kill_step - resume_step if resume_step is not None else None
+            ),
+            "reforms": mres.reforms,
+            "stop_reason": mres.stop_reason,
+            "coordinator_ports": ports,
+            "fresh_ports": len(set(ports)) == len(ports),
+            "remesh_mttr_s": remesh_mttr,
+            "replan_receipts": receipts,
+            "replan_error": replan.get("error") if replan else None,
+            "steps_per_s": {
+                f"s{s}r{r}": f["steps_per_s"]
+                for (s, r), f in sorted(finals.items())
+            },
+            "params_crc": {
+                f"s{s}r{r}": f["params_crc"]
+                for (s, r), f in sorted(finals.items())
+            },
+            "naive": naive,
+            "remesh_beats_naive": (
+                remesh_mttr is not None
+                and naive is not None
+                and naive["restart_mttr_s"] is not None
+                and remesh_mttr < naive["restart_mttr_s"]
+            )
+            if include_naive
+            else None,
+            "trace_pids": pids,
+        }
+        (obs_dir / "mpmd.json").write_text(
+            json.dumps(result, indent=2, sort_keys=True) + "\n"
+        )
+        return result
+    finally:
+        set_tracer(prev_tracer)
+
+
+if __name__ == "__main__":
+    sys.exit(child_main())

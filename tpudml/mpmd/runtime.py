@@ -1,0 +1,504 @@
+"""Per-stage programs and the heterogeneous 1F1B host loop.
+
+Where the SPMD pipeline engine (``tpudml/parallel/pp.py``) expresses
+1F1B as one jitted scan over stacked stage weights — every process
+running the same program — the MPMD runtime gives each stage its *own*
+jitted programs and drives the schedule as a host loop
+(:class:`StageWorker`): forward and backward are per-microbatch jits,
+activations and cotangents cross stage boundaries as host arrays over
+the ``comm/p2p`` wire, and the only intra-stage collective is the
+step-end gradient allreduce over the stage's data axis.
+
+Precision contract (what "a bf16 stage feeding an f32 head" means):
+
+- parameters are **f32 master copies** everywhere; a stage casts them
+  (and its input) to its compute ``dtype`` at program entry, so the
+  cast's VJP returns parameter gradients in f32.
+- the wire carries activations in the *producer's* dtype and
+  cotangents in that same dtype (the consumer's entry cast has an
+  ``astype`` VJP, so its input gradient lands in the producer's dtype
+  with no explicit conversion code).
+- the head's per-microbatch loss contribution is ``sum(row CE) /
+  global_batch`` — a *local, exact* share of the global mean loss, so
+  cotangents need no cross-stage rescaling and gradients accumulate as
+  plain sums: microbatch sums on each rank, then one SUM allreduce
+  over the stage group (:class:`GroupReducer`). This is what makes a
+  2-stage×2-dp MPMD step mathematically identical to the
+  single-program reference (:func:`reference_step_fn`) up to f32
+  summation order.
+
+The worker is deliberately runnable two ways: spawned children
+(``mpmd/drill.py``, real gloo worlds) and in-process threads over
+``socketpair`` channels (the grad-parity tests) — same code path, only
+the channel construction and the reducer's world differ.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from tpudml.comm.p2p import TAG_ACT, TAG_GRAD, PeerDeadError, _resolve_dtype
+from tpudml.mpmd.spec import PipelineSpec, boundary_plan, warmup_microbatches
+
+__all__ = [
+    "DrainSignal",
+    "StageProgram",
+    "GroupReducer",
+    "StageWorker",
+    "stage_layer_dims",
+    "init_stage_params",
+    "make_batch_fn",
+    "reference_step_fn",
+]
+
+
+class DrainSignal(Exception):
+    """A peer (or the group's drain barrier) declared this step dead:
+    discard in-flight microbatches, do not touch params, exit the step
+    loop cleanly. Carries the step it fired at."""
+
+    def __init__(self, step: int, why: str):
+        super().__init__(f"drain at step {step}: {why}")
+        self.step = step
+        self.why = why
+
+
+# ------------------------------------------------------------ the model
+
+
+def stage_layer_dims(feature_dim: int, hidden, classes: int,
+                     n_stages: int) -> list:
+    """Split the MLP's layer chain ``[feature] + hidden + [classes]``
+    contiguously across ``n_stages``: each stage gets a list of
+    ``(d_in, d_out)`` pairs; the last stage owns the logits layer."""
+    dims = [feature_dim, *hidden, classes]
+    n_layers = len(dims) - 1
+    if n_layers < n_stages:
+        raise ValueError(
+            f"{n_layers} layers cannot split over {n_stages} stages"
+        )
+    splits = np.array_split(np.arange(n_layers), n_stages)
+    return [
+        [(dims[l], dims[l + 1]) for l in part] for part in splits
+    ]
+
+
+def init_stage_params(stage: int, n_stages: int, feature_dim: int, hidden,
+                      classes: int, seed: int) -> list:
+    """Deterministic f32 host-numpy init, seeded per *global* layer
+    index — so the per-stage trees concatenate to exactly the params
+    the single-program reference initializes."""
+    splits = np.array_split(
+        np.arange(len([feature_dim, *hidden, classes]) - 1), n_stages
+    )
+    dims = stage_layer_dims(feature_dim, hidden, classes, n_stages)[stage]
+    out = []
+    for l, (din, dout) in zip(splits[stage], dims):
+        rng = np.random.default_rng(seed * 7919 + int(l))
+        out.append({
+            "w": (rng.standard_normal((din, dout)) / math.sqrt(din)).astype(
+                np.float32
+            ),
+            "b": np.zeros((dout,), np.float32),
+        })
+    return out
+
+
+def make_batch_fn(global_batch: int, feature_dim: int, classes: int,
+                  seed: int):
+    """Teacher-labeled batches as a pure function of the step index —
+    the elastic drill's replayability contract (any incarnation at any
+    world sees the same global rows for step k)."""
+    teacher = (
+        np.random.default_rng(seed + 777)
+        .standard_normal((feature_dim, classes))
+        .astype(np.float32)
+    )
+
+    def batch_for(step: int):
+        rng = np.random.default_rng(seed * 1_000_003 + step)
+        x = rng.standard_normal((global_batch, feature_dim)).astype(np.float32)
+        y = np.argmax(x @ teacher, axis=1).astype(np.int32)
+        return x, y
+
+    return batch_for
+
+
+class StageProgram:
+    """One stage's jitted programs: forward, recompute-backward, and —
+    for the head stage — the fused loss/gradient program. Parameters
+    stay f32; the entry casts define the precision boundary."""
+
+    def __init__(self, spec: PipelineSpec, stage: int, *, feature_dim: int,
+                 hidden, classes: int, seed: int, lr: float, momentum: float):
+        import jax
+        import jax.numpy as jnp
+
+        self.spec = spec
+        self.stage = stage
+        self.is_first = stage == 0
+        self.is_head = stage == len(spec.stages) - 1
+        self.dtype = jnp.dtype(spec.stages[stage].dtype)
+        self.params = init_stage_params(
+            stage, len(spec.stages), feature_dim, hidden, classes, seed
+        )
+        self.momentum = jax.tree.map(np.zeros_like, self.params)
+        self.out_features = stage_layer_dims(
+            feature_dim, hidden, classes, len(spec.stages)
+        )[stage][-1][1]
+        dtype = self.dtype
+        head = self.is_head
+        gb = spec.global_batch
+
+        def apply(p, h):
+            h = h.astype(dtype)
+            last = len(p) - 1
+            for i, layer in enumerate(p):
+                h = h @ layer["w"].astype(dtype) + layer["b"].astype(dtype)
+                if not (head and i == last):
+                    h = jax.nn.relu(h)
+            return h
+
+        def loss_contrib(p, a, y):
+            logits = apply(p, a).astype(jnp.float32)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            rows = -jnp.take_along_axis(logp, y[:, None], axis=-1)[:, 0]
+            return rows.sum() / gb
+
+        self._fwd = jax.jit(apply)
+
+        def bwd(p, x, ct):
+            _, vjp = jax.vjp(lambda pp, xx: apply(pp, xx), p, x)
+            gp, gx = vjp(ct)
+            return gp, gx
+
+        self._bwd = jax.jit(bwd)
+        self._loss_bwd = jax.jit(
+            jax.value_and_grad(loss_contrib, argnums=(0, 1))
+        )
+
+        def update(p, m, g):
+            new_m = jax.tree.map(
+                lambda mm, gg: momentum * mm + gg, m, g
+            )
+            new_p = jax.tree.map(lambda pp, mm: pp - lr * mm, p, new_m)
+            return new_p, new_m
+
+        self._update = jax.jit(update)
+
+    def fwd(self, x: np.ndarray) -> np.ndarray:
+        return np.asarray(self._fwd(self.params, x))
+
+    def bwd(self, x: np.ndarray, ct: np.ndarray):
+        gp, gx = self._bwd(self.params, x, ct)
+        return gp, np.asarray(gx)
+
+    def loss_bwd(self, a: np.ndarray, y: np.ndarray):
+        loss, (gp, ga) = self._loss_bwd(self.params, a, y)
+        return float(loss), gp, np.asarray(ga)
+
+    def apply_update(self, grads) -> None:
+        import jax
+
+        p, m = self._update(self.params, self.momentum, grads)
+        self.params = jax.tree.map(np.asarray, p)
+        self.momentum = jax.tree.map(np.asarray, m)
+
+
+class GroupReducer:
+    """SUM-allreduce of host-numpy trees over the stage's data axis.
+
+    The grads live on the host (they fall out of per-microbatch jits),
+    so the cross-process reduction is expressed by *stacking over the
+    data axis*: each process contributes its tree as one row of a
+    ``("data",)``-sharded global array and a tiny jitted ``sum(0)``
+    makes XLA (gloo-backed across processes) perform the allreduce.
+    World 1 short-circuits to identity — the in-process parity tests
+    never touch ``jax.distributed``.
+    """
+
+    def __init__(self, dp: int):
+        self.dp = int(dp)
+        if self.dp > 1:
+            import jax
+            from jax.sharding import Mesh, NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            devs = np.asarray(jax.devices()[: self.dp])
+            if devs.size < self.dp:
+                raise ValueError(
+                    f"GroupReducer: {devs.size} devices < dp {self.dp}"
+                )
+            self._mesh = Mesh(devs, ("data",))
+            self._sharded = NamedSharding(self._mesh, P("data"))
+            self._sum = jax.jit(
+                lambda t: jax.tree.map(lambda a: a.sum(0), t),
+                out_shardings=NamedSharding(self._mesh, P()),
+            )
+
+    def sum(self, tree):
+        if self.dp == 1:
+            return tree
+        import jax
+
+        def lift(a):
+            a = np.ascontiguousarray(np.asarray(a))
+            return jax.make_array_from_callback(
+                (self.dp, *a.shape), self._sharded, lambda idx, v=a: v[None]
+            )
+
+        out = self._sum(jax.tree.map(lift, tree))
+        return jax.tree.map(
+            lambda d: np.asarray(d.addressable_data(0)), out
+        )
+
+
+@dataclass
+class _BoundaryIO:
+    """This rank's slice of one boundary plan, grouped by the microbatch
+    index on this rank's side."""
+
+    by_mb: dict = field(default_factory=dict)
+
+    @classmethod
+    def build(cls, transfers, *, key) -> "_BoundaryIO":
+        out = cls()
+        for t in transfers:
+            out.by_mb.setdefault(key(t), []).append(t)
+        for lst in out.by_mb.values():
+            lst.sort(key=lambda t: t.index)
+        return out
+
+
+class StageWorker:
+    """One rank of one stage: runs the heterogeneous 1F1B schedule.
+
+    Per step: ``warmup_microbatches`` forwards, then strict
+    forward/backward alternation, then the backward tail; then the
+    group drain vote (:class:`~tpudml.comm.p2p.DrainBarrier`), and only
+    on a unanimous ``ok`` the gradient SUM-allreduce and the replicated
+    SGD+momentum update. Any :class:`~tpudml.comm.p2p.PeerDeadError`
+    (or a ``drain`` verdict) raises :class:`DrainSignal` — parameters
+    are left at the last completed step, which is exactly the state the
+    checkpoint protocol resumes from.
+    """
+
+    def __init__(self, spec: PipelineSpec, stage: int, rank: int, *,
+                 program: StageProgram, batch_for,
+                 up_features: int | None = None,
+                 up_channels: dict | None = None,
+                 down_channels: dict | None = None,
+                 barrier=None, reducer: GroupReducer | None = None):
+        self.spec = spec
+        self.stage = stage
+        self.rank = rank
+        self.program = program
+        self.batch_for = batch_for
+        self.up = dict(up_channels or {})      # edge -> Channel (to stage-1)
+        self.down = dict(down_channels or {})  # edge -> Channel (to stage+1)
+        self.barrier = barrier
+        self.reducer = reducer or GroupReducer(1)
+        st = spec.stages[stage]
+        self.m = st.microbatches
+        self.warmup = warmup_microbatches(spec, stage)
+        self.in_plan = None
+        self.out_plan = None
+        if stage > 0:
+            self.in_plan = _BoundaryIO.build(
+                [t for t in boundary_plan(spec, stage - 1)
+                 if t.dst_rank == rank],
+                key=lambda t: t.dst_microbatch,
+            )
+            self.up_dtype = _resolve_dtype(spec.stages[stage - 1].dtype)
+            if up_features is None:
+                raise ValueError(
+                    "non-first stages need up_features (the upstream "
+                    "stage's output width)"
+                )
+            self._up_features = int(up_features)
+        if stage < len(spec.stages) - 1:
+            self.out_plan = _BoundaryIO.build(
+                [t for t in boundary_plan(spec, stage)
+                 if t.src_rank == rank],
+                key=lambda t: t.src_microbatch,
+            )
+        self.rows = spec.rows_per_rank(stage)
+        self.losses: list = []
+
+    # ------------------------------------------------------- microbatch
+
+    def _input_for(self, step: int, mb: int) -> np.ndarray:
+        if self.stage == 0:
+            x, _ = self.batch_for(step)
+            lo, hi = self.spec.row_interval(0, mb, self.rank)
+            return x[lo:hi]
+        arr = np.zeros((self.rows, self._up_features), self.up_dtype)
+        for t in self.in_plan.by_mb.get(mb, []):
+            chunk = self.up[t.edge].recv(
+                step=step, microbatch=t.index, tag=TAG_ACT
+            )
+            arr[t.dst_rows[0]: t.dst_rows[1]] = chunk
+        return arr
+
+    def _labels_for(self, step: int, mb: int) -> np.ndarray:
+        _, y = self.batch_for(step)
+        lo, hi = self.spec.row_interval(self.stage, mb, self.rank)
+        return y[lo:hi]
+
+    def _forward(self, step: int, mb: int, stash: dict) -> None:
+        x = self._input_for(step, mb)
+        stash[mb] = x
+        if self.program.is_head:
+            return  # the head's forward is fused into its loss program
+        act = self.program.fwd(x)
+        for t in self.out_plan.by_mb.get(mb, []):
+            self.down[t.edge].send(
+                act[t.src_rows[0]: t.src_rows[1]],
+                step=step, microbatch=t.index, tag=TAG_ACT,
+            )
+
+    def _send_up(self, step: int, mb: int, gx: np.ndarray) -> None:
+        for t in self.in_plan.by_mb.get(mb, []):
+            self.up[t.edge].send(
+                gx[t.dst_rows[0]: t.dst_rows[1]],
+                step=step, microbatch=t.index, tag=TAG_GRAD,
+            )
+
+    def _backward(self, step: int, mb: int, stash: dict, acc: dict) -> None:
+        import jax
+
+        x = stash.pop(mb)
+        if self.program.is_head:
+            loss, gp, ga = self.program.loss_bwd(x, self._labels_for(step, mb))
+            acc["loss"] += loss
+            if self.stage > 0:
+                self._send_up(step, mb, ga)
+        else:
+            ct = np.zeros(
+                (self.rows, self.program.out_features), self.program.dtype
+            )
+            for t in self.out_plan.by_mb.get(mb, []):
+                chunk = self.down[t.edge].recv(
+                    step=step, microbatch=t.index, tag=TAG_GRAD
+                )
+                ct[t.src_rows[0]: t.src_rows[1]] = chunk
+            gp, _gx = self.program.bwd(x, ct)
+            if self.stage > 0:
+                self._send_up(step, mb, _gx)
+        acc["g"] = (
+            gp if acc["g"] is None
+            else jax.tree.map(np.add, acc["g"], jax.tree.map(np.asarray, gp))
+        )
+
+    # -------------------------------------------------------------- step
+
+    def run_step(self, step: int) -> float:
+        """One full 1F1B step; returns the stage-group global loss (the
+        head stage's mean CE; NaN elsewhere). Raises
+        :class:`DrainSignal` instead of touching params on any peer
+        death or drain verdict."""
+        import jax
+
+        stash: dict = {}
+        acc = {"g": None, "loss": 0.0}
+        w, m = self.warmup, self.m
+        try:
+            for k in range(w):
+                self._forward(step, k, stash)
+            for i in range(m - w):
+                self._forward(step, w + i, stash)
+                self._backward(step, i, stash, acc)
+            for i in range(m - w, m):
+                self._backward(step, i, stash, acc)
+        except PeerDeadError as e:
+            if self.barrier is not None:
+                self.barrier.vote(step, ok=False)
+            raise DrainSignal(step, f"peer dead on edge {e.edge}") from e
+        if self.barrier is not None and not self.barrier.vote(step, ok=True):
+            raise DrainSignal(step, "group drain verdict")
+        acc["g"] = jax.tree.map(np.asarray, acc["g"])
+        reduced = self.reducer.sum(
+            {"g": acc["g"], "loss": np.float32(acc["loss"])}
+        )
+        self.program.apply_update(reduced["g"])
+        loss = (
+            float(reduced["loss"]) if self.program.is_head else float("nan")
+        )
+        self.losses.append(np.float32(loss if loss == loss else 0.0))
+        return loss
+
+
+# ---------------------------------------------- single-program reference
+
+
+def reference_step_fn(spec: PipelineSpec, *, feature_dim: int, hidden,
+                      classes: int, seed: int, lr: float, momentum: float):
+    """The *equivalent single-program* the heterogeneity test compares
+    against: one jitted step applying every stage's program with the
+    SAME per-stage chunking and the SAME entry casts made explicit —
+    the trunk runs per trunk-microbatch chunk and concatenates, the
+    head sums per head-microbatch loss contributions — so autodiff
+    reproduces the identical per-chunk low-precision roundings and the
+    remaining difference to the MPMD run is f32 summation order.
+
+    Returns ``(params, step_fn)`` where ``step_fn(params, mom, x, y) ->
+    (params, mom, loss, grads)``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    n = len(spec.stages)
+    programs = [
+        StageProgram(spec, s, feature_dim=feature_dim, hidden=hidden,
+                     classes=classes, seed=seed, lr=lr, momentum=momentum)
+        for s in range(n)
+    ]
+    params = [p.params for p in programs]
+    mom = [p.momentum for p in programs]
+    dtypes = [jnp.dtype(st.dtype) for st in spec.stages]
+    gb = spec.global_batch
+
+    def apply_stage(s, p, h):
+        h = h.astype(dtypes[s])
+        last = len(p) - 1
+        is_head = s == n - 1
+        for i, layer in enumerate(p):
+            h = h @ layer["w"].astype(dtypes[s]) + layer["b"].astype(dtypes[s])
+            if not (is_head and i == last):
+                h = jax.nn.relu(h)
+        return h
+
+    def loss_fn(all_params, x, y):
+        h = x
+        for s in range(n - 1):
+            mchunks = jnp.split(h, spec.stages[s].microbatches, axis=0)
+            h = jnp.concatenate(
+                [apply_stage(s, all_params[s], c) for c in mchunks], axis=0
+            )
+        head = n - 1
+        hchunks = jnp.split(h, spec.stages[head].microbatches, axis=0)
+        ychunks = jnp.split(y, spec.stages[head].microbatches, axis=0)
+        loss = 0.0
+        for c, yc in zip(hchunks, ychunks):
+            logits = apply_stage(head, all_params[head], c).astype(jnp.float32)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            loss = loss + (
+                -jnp.take_along_axis(logp, yc[:, None], axis=-1)[:, 0]
+            ).sum() / gb
+        return loss
+
+    @jax.jit
+    def step_fn(all_params, all_mom, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(all_params, x, y)
+        new_mom = jax.tree.map(
+            lambda mm, gg: momentum * mm + gg, all_mom, grads
+        )
+        new_params = jax.tree.map(
+            lambda pp, mm: pp - lr * mm, all_params, new_mom
+        )
+        return new_params, new_mom, loss, grads
+
+    return params, mom, step_fn

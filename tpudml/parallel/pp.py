@@ -25,6 +25,13 @@ in ``tpudml.parallel.mp`` remains the non-micro-batched alternative.
 Optimizer state lives sharded over the stage axis, so updates happen where
 the parameters live — the DistributedOptimizer contract
 (codes/task4/model.py:126) by construction.
+
+Everything here is SPMD: every process runs the same scan, so stages
+must agree on program, precision, and microbatch count, and a membership
+event restarts the whole world. ``tpudml/mpmd`` is the multi-program
+counterpart — one gloo world per stage, host-TCP boundary transfers, a
+1F1B *host* loop, and re-mesh-in-place — for pipelines whose stages
+differ in code, dtype, or chunking (arXiv 2412.14374).
 """
 
 from __future__ import annotations

@@ -66,7 +66,8 @@ from tpudml.serve.load import Request
 
 @dataclass(frozen=True)
 class FleetConfig:
-    """Fleet shape: N identical replicas of one engine template.
+    """Fleet shape: N replicas of one engine template — or a
+    heterogeneous mix.
 
     ``engine.step_time_s`` is REQUIRED — the fleet advances every
     replica on one global virtual clock (one fleet step = one decode
@@ -75,12 +76,23 @@ class FleetConfig:
     script). ``max_queue`` bounds the router's single waiting line
     (the engine template's own ``max_queue`` is ignored: replicas never
     see a queue). ``reform_after_steps`` re-forms a killed replica that
-    many fleet steps later (None: it stays dead)."""
+    many fleet steps later (None: it stays dead).
+
+    ``replica_engines`` makes the fleet heterogeneous: one
+    :class:`ServeConfig` per replica (e.g. one ``weight_quant="int8"``
+    replica among f32 ones). The template stays the ROUTER policy —
+    clock (``step_time_s``), ``deadline_s``, ``eos_token`` — so every
+    per-replica config must agree with it on ``step_time_s`` (one
+    virtual clock) and each is priced by ITS OWN cost model: an int8
+    replica's smaller param-byte term makes it honestly cheaper under
+    cache-bound load, and the router's cheapest-feasible placement
+    routes traffic there without any special-casing."""
 
     engine: ServeConfig
     replicas: int = 2
     max_queue: int | None = None
     reform_after_steps: int | None = None
+    replica_engines: tuple | None = None
 
     def __post_init__(self):
         if self.replicas < 1:
@@ -97,6 +109,31 @@ class FleetConfig:
             raise ValueError("max_queue must be >= 1 (or None)")
         if self.reform_after_steps is not None and self.reform_after_steps < 1:
             raise ValueError("reform_after_steps must be >= 1 (or None)")
+        if self.replica_engines is not None:
+            object.__setattr__(
+                self, "replica_engines", tuple(self.replica_engines)
+            )
+            if len(self.replica_engines) != self.replicas:
+                raise ValueError(
+                    f"replica_engines has {len(self.replica_engines)} "
+                    f"entries for {self.replicas} replicas"
+                )
+            for i, e in enumerate(self.replica_engines):
+                if e.step_time_s != self.engine.step_time_s:
+                    raise ValueError(
+                        f"replica {i}: step_time_s {e.step_time_s} != "
+                        f"template {self.engine.step_time_s} — the fleet "
+                        "runs one virtual clock"
+                    )
+                if e.spec_k:
+                    reject("serve_fleet_spec", exc=ServeCompositionError)
+
+    def engine_for(self, i: int) -> ServeConfig:
+        """Replica ``i``'s engine config (the template when the fleet is
+        homogeneous)."""
+        if self.replica_engines is not None:
+            return self.replica_engines[i]
+        return self.engine
 
 
 @dataclass
@@ -408,7 +445,7 @@ class FleetRouter:
         self.model = model
         self.replanner = replanner
         self.replicas = [
-            _Replica(i, model, params, cfg.engine)
+            _Replica(i, model, params, cfg.engine_for(i))
             for i in range(cfg.replicas)
         ]
 
