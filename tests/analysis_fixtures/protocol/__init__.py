@@ -1,0 +1,20 @@
+"""Protocol-pass fixture modules, discovered by filename.
+
+Each module is one seeded scenario for the P-series rules: ``RULE``
+names the rule under test, ``EXPECT`` is ``"fire"`` or ``"silent"``,
+and ``MODE`` selects how the scenario is evaluated:
+
+- ``"schedule"`` — ``build()`` returns ``(spec, schedules)``: a
+  ``PipelineSpec`` plus a (possibly hand-tampered) ``build_schedules``
+  output; the test runs ``check_schedules`` over it. Tampering the
+  model rather than the spec is the point — a *constructible* spec is
+  protocol-clean by design, so the broken twins simulate the bug
+  classes (dropped frames, reordered 1F1B loops, divergent collective
+  sequences, missing votes) the checker exists to catch.
+- ``"ast"`` — the module's own source IS the scenario; the test runs
+  ``analyze_file`` on it and filters for ``RULE`` (P304's fire twin
+  contains deliberately leaky port code — never executed, import-safe).
+
+test_protocol.py parametrizes over the directory listing and pins that
+every P rule has both twins, mirroring the jaxpr fixture protocol.
+"""

@@ -1,0 +1,15 @@
+"""P300 silent: the drill's [2,2] pipeline with both sides deriving the
+schedule from the same boundary plan — every sent frame has exactly one
+receiver and vice versa."""
+
+RULE = "P300"
+EXPECT = "silent"
+MODE = "schedule"
+
+
+def build():
+    from tpudml.analysis.protocol import build_schedules
+    from tpudml.mpmd.drill import _drill_pipeline
+
+    spec = _drill_pipeline()
+    return spec, build_schedules(spec)

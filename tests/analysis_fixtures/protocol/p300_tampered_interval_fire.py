@@ -1,0 +1,27 @@
+"""P300 firing: one act frame's row interval is tampered on the send
+side only — the sender believes it ships rows (0, 999) while every
+receiver still expects the planned interval, so the (edge, mb, tag,
+rows) multisets no longer match. This is the "tampered boundary
+interval" regression: the two endpoints derived *different* boundary
+plans."""
+
+from dataclasses import replace
+
+RULE = "P300"
+EXPECT = "fire"
+MODE = "schedule"
+
+
+def build():
+    from tpudml.analysis.protocol import build_schedules
+    from tpudml.mpmd.drill import _drill_pipeline
+
+    spec = _drill_pipeline()
+    sched = build_schedules(spec)
+    key = (0, 0)
+    evs = list(sched[key])
+    i = next(k for k, e in enumerate(evs)
+             if e.kind == "send" and e.tag == "act")
+    evs[i] = replace(evs[i], rows=(0, 999))
+    sched[key] = evs
+    return spec, sched

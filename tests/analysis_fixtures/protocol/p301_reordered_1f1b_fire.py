@@ -1,0 +1,20 @@
+"""P301 firing: trunk rank 0 runs its host loop in REVERSE — the
+vote/collective tail first, p2p frames last. Its group peer reaches the
+drain vote while rank 0 sits in the collective (barrier kinds disagree)
+and the head stage starves waiting for activations that are scheduled
+after a barrier that can never complete: a wait-for cycle across
+ranks."""
+
+RULE = "P301"
+EXPECT = "fire"
+MODE = "schedule"
+
+
+def build():
+    from tpudml.analysis.protocol import build_schedules
+    from tpudml.mpmd.drill import _drill_pipeline
+
+    spec = _drill_pipeline()
+    sched = build_schedules(spec)
+    sched[(0, 0)] = list(reversed(sched[(0, 0)]))
+    return spec, sched

@@ -1,0 +1,14 @@
+"""P301 silent: the heterogeneous 3-stage [2,2,2] pipeline in its real
+1F1B order — warmup depths from ``warmup_microbatches`` keep enough
+rows in flight that the simulation drains every schedule."""
+
+RULE = "P301"
+EXPECT = "silent"
+MODE = "schedule"
+
+
+def build():
+    from tpudml.analysis.protocol import build_schedules, protocol_surface
+
+    spec = protocol_surface()["mpmd_3stage"]
+    return spec, build_schedules(spec)

@@ -1,0 +1,25 @@
+"""P302 firing: trunk rank 1's gradient allreduce carries a different
+operand shape than rank 0's — the per-rank model code diverged (e.g. a
+rank-conditional parameter slice) and gloo would deadlock or corrupt,
+not diagnose. The simulation itself stays happy (barriers only match
+kinds), which is exactly why the signature comparison is its own
+rule."""
+
+from dataclasses import replace
+
+RULE = "P302"
+EXPECT = "fire"
+MODE = "schedule"
+
+
+def build():
+    from tpudml.analysis.protocol import build_schedules
+    from tpudml.mpmd.drill import _drill_pipeline
+
+    spec = _drill_pipeline()
+    sched = build_schedules(spec)
+    sched[(0, 1)] = [
+        replace(e, shape=(4096,)) if e.kind == "collective" else e
+        for e in sched[(0, 1)]
+    ]
+    return spec, sched

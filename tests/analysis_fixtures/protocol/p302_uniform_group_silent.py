@@ -1,0 +1,20 @@
+"""P302 silent: every rank of each dp>1 stage group carries the same
+injected (op, axis, shape) collective sequence — the stage_collectives
+hook with identical per-stage signatures, as traced programs would
+provide via ``traced_collective_events``."""
+
+RULE = "P302"
+EXPECT = "silent"
+MODE = "schedule"
+
+
+def build():
+    from tpudml.analysis.protocol import build_schedules
+    from tpudml.mpmd.drill import _drill_pipeline
+
+    spec = _drill_pipeline()
+    colls = {
+        s: (("psum", "data", (8, 16)), ("psum", "data", (16,)))
+        for s in range(len(spec.stages))
+    }
+    return spec, build_schedules(spec, stage_collectives=colls)

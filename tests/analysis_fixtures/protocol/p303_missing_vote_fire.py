@@ -1,0 +1,20 @@
+"""P303 firing: the entire trunk stage group skips its drain vote and
+goes straight into the gradient allreduce. No P301 fires (the group
+still agrees on the barrier sequence), but a peer death mid-step now
+parks the group inside gloo instead of draining at the ctl barrier —
+the membership-event path the vote exists to protect."""
+
+RULE = "P303"
+EXPECT = "fire"
+MODE = "schedule"
+
+
+def build():
+    from tpudml.analysis.protocol import build_schedules
+    from tpudml.mpmd.drill import _drill_pipeline
+
+    spec = _drill_pipeline()
+    sched = build_schedules(spec)
+    for r in range(spec.stages[0].dp):
+        sched[(0, r)] = [e for e in sched[(0, r)] if e.kind != "vote"]
+    return spec, sched

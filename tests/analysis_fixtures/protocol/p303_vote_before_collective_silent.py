@@ -1,0 +1,14 @@
+"""P303 silent: the real run_step order — every dp>1 rank votes on the
+drain barrier before entering the stage-group collective."""
+
+RULE = "P303"
+EXPECT = "silent"
+MODE = "schedule"
+
+
+def build():
+    from tpudml.analysis.protocol import build_schedules
+    from tpudml.mpmd.drill import _drill_pipeline
+
+    spec = _drill_pipeline()
+    return spec, build_schedules(spec)

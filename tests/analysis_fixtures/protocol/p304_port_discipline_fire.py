@@ -1,0 +1,38 @@
+"""P304 firing twins (AST mode: this file's own source is analyzed,
+never executed): (a) a bound-and-listening socket that neither escapes
+the scope nor reaches ``close()`` — leaked the moment ``accept`` (or
+anything before it) raises; (b) the bind-and-hold reservations released
+*before* the round's wiring is committed — a squatter can take the
+ports in the window between release and spawn."""
+
+import json
+import socket
+
+RULE = "P304"
+EXPECT = "fire"
+MODE = "ast"
+
+
+def accept_one_leaky(host, port):
+    lst = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    lst.bind((host, port))
+    lst.listen(1)
+    conn, _ = lst.accept()
+    return conn
+
+
+def form_round_released_early(host, path, reserve, spawn):
+    holds = []
+    ports = []
+    for _ in range(2):
+        sock, p = reserve(host)
+        holds.append(sock)
+        ports.append(p)
+    for hold in holds:
+        hold.close()
+    write_wiring(path, json.dumps({"ports": ports}))
+    spawn(ports)
+
+
+def write_wiring(path, doc):
+    path.write_text(doc)

@@ -1,0 +1,39 @@
+"""P304 silent twins (AST mode): the same two shapes done right — the
+listener is closed in a ``finally`` (and handed off, either suffices),
+and the bind-and-hold reservations survive until ``write_wiring`` has
+committed the topology."""
+
+import json
+import socket
+
+RULE = "P304"
+EXPECT = "silent"
+MODE = "ast"
+
+
+def accept_one_safely(host, port):
+    lst = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    try:
+        lst.bind((host, port))
+        lst.listen(1)
+        conn, _ = lst.accept()
+        return conn
+    finally:
+        lst.close()
+
+
+def form_round_held_until_commit(host, path, reserve, spawn):
+    holds = []
+    ports = []
+    for _ in range(2):
+        sock, p = reserve(host)
+        holds.append(sock)
+        ports.append(p)
+    write_wiring(path, json.dumps({"ports": ports}))
+    for hold in holds:
+        hold.close()
+    spawn(ports)
+
+
+def write_wiring(path, doc):
+    path.write_text(doc)

@@ -277,16 +277,53 @@ def fleet_summary(run_dir: Path) -> str | None:
     return "\n\n".join(out)
 
 
+def protocol_verdict(run_dir: Path) -> str | None:
+    """One-line verdict of the MPMDController's pre-launch protocol
+    gate (``protocol_report.json``, written per checked round), so the
+    static evidence sits next to the dynamic drill verdict for the same
+    spec."""
+    for p in (run_dir / "protocol_report.json",
+              run_dir / "run" / "protocol_report.json",
+              run_dir / "obs" / "protocol_report.json"):
+        if not p.is_file():
+            continue
+        try:
+            doc = json.loads(p.read_text())
+        except ValueError:
+            return None
+        checks = doc.get("checks") or []
+        line = (f"protocol gate: {len(checks)} spec check(s)  "
+                f"ok={doc.get('ok')}")
+        bad = [c for c in checks if not c.get("ok")]
+        if bad:
+            c = bad[0]
+            rules = sorted({
+                f.get("rule") for f in c.get("findings", ())
+                if f.get("severity") == "error"
+            })
+            line += (f"  — REJECTED at round {c.get('round')} "
+                     f"({', '.join(rules)}); launch refused")
+        else:
+            line += "  (every round's spec P300-P303 clean pre-launch)"
+        return line
+    return None
+
+
 def mpmd_summary(run_dir: Path) -> str | None:
     """MPMD section: the re-mesh drill's verdict (``obs/mpmd.json``,
-    written by ``python -m tpudml.mpmd --drill``) plus per-edge boundary
-    transfer aggregates read out of the merged per-stage trace (one pid
-    per stage group, ``cat="comm"`` spans with edge-labeled bytes)."""
+    written by ``python -m tpudml.mpmd --drill``), the pre-launch
+    protocol gate's verdict when a ``protocol_report.json`` is present,
+    plus per-edge boundary transfer aggregates read out of the merged
+    per-stage trace (one pid per stage group, ``cat="comm"`` spans with
+    edge-labeled bytes)."""
+    verdict = protocol_verdict(run_dir)
     path = run_dir / "obs" / "mpmd.json"
     if not path.is_file():
         path = run_dir / "mpmd.json"
     if not path.is_file():
-        return None
+        # A rejected launch leaves the gate receipts but no drill
+        # verdict — still worth a section.
+        return verdict
     doc = json.loads(path.read_text())
     out = []
     victim = doc.get("victim") or {}
@@ -296,6 +333,8 @@ def mpmd_summary(run_dir: Path) -> str | None:
         f"in_place={doc.get('in_place')}  "
         f"stop_reason={doc.get('stop_reason', '?')}"
     )
+    if verdict:
+        out.append(verdict)
     out.append(
         f"re-mesh: victim=stage {victim.get('stage', '?')} rank "
         f"{victim.get('rank', '?')} (rc {victim.get('rc', '?')})  "

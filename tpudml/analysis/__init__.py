@@ -19,7 +19,15 @@ full rule catalogue):
   replication lattice — missing psums under ``check_rep=False``,
   shard-dependent while trip counts around collectives, donated-buffer
   reuse, allreduce-then-shard waste — and feeds the static comm/HBM
-  cost reports in ``cost`` (``--cost`` / ``analysis/cost_report.json``).
+  cost reports in ``cost`` (``--cost`` / ``analysis/cost_report.json``);
+- the **protocol pass** (``protocol``, rules P300–P304) models every
+  (stage, rank) of the MPMD pipeline as an ordered schedule of blocking
+  events (p2p frames, drain votes, stage-group collectives) and checks
+  the *composed* system for boundary asymmetry, cross-rank deadlock,
+  collective-sequence divergence and vote-before-collective ordering —
+  jax-free, so ``MPMDController`` runs it as a pre-launch gate
+  (``--protocol`` on the CLI; P304, the port-discipline lint, rides in
+  the AST pass).
 
 Run it as ``python -m tpudml.analysis`` (``--strict`` for CI, paired
 with the committed ``analysis/allowlist.toml``).
@@ -55,7 +63,18 @@ from tpudml.analysis.findings import RULES, Finding, sort_findings
 from tpudml.analysis.jaxpr_pass import (
     analyze_callable,
     analyze_closed_jaxpr,
+    collective_shape_signature,
     donation_findings,
+)
+from tpudml.analysis.protocol import (
+    Ev,
+    analyze_pipeline,
+    analyze_protocol_surface,
+    build_schedules,
+    check_schedules,
+    protocol_surface,
+    traced_collective_events,
+    validate_fixture_events,
 )
 
 __all__ = [
@@ -63,6 +82,7 @@ __all__ = [
     "CommEvent",
     "DataflowResult",
     "EntrypointCost",
+    "Ev",
     "Finding",
     "ENTRYPOINTS",
     "analyze_callable",
@@ -71,9 +91,17 @@ __all__ = [
     "analyze_entrypoint",
     "analyze_entrypoints",
     "analyze_file",
+    "analyze_pipeline",
+    "analyze_protocol_surface",
     "analyze_source",
     "analyze_tree",
     "build_cost_report",
+    "build_schedules",
+    "check_schedules",
+    "collective_shape_signature",
+    "protocol_surface",
+    "traced_collective_events",
+    "validate_fixture_events",
     "check_hbm_budget",
     "cost_entrypoints",
     "donation_findings",

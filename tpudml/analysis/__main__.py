@@ -4,13 +4,17 @@ Report-only by default; ``--strict`` (the CI mode) exits non-zero when
 any finding is not covered by the committed allowlist, and warns on
 allowlist entries that matched nothing (stale suppressions). ``--cost``
 switches to the static cost reports: a per-entrypoint comm/HBM table on
-stdout plus ``analysis/cost_report.json`` for machines. ``--format``
-selects the findings output: ``text`` (human), ``json``, or ``github``
-(workflow-annotation lines). The jaxpr pass needs >= 2 visible devices,
-so an 8-device CPU host platform is provisioned before the first
-backend touch — same dance as ``tests/conftest.py`` — which makes the
-tool runnable on any dev box with ``JAX_PLATFORMS=cpu``, no TPU
-required.
+stdout plus ``analysis/cost_report.json`` for machines. ``--protocol``
+runs only the cross-rank protocol pass (P300–P303 over the repo's
+drill/fixture ``PipelineSpec`` surface plus the AST-hosted P304 port
+lint) — jax-free, milliseconds, byte-deterministic; the same findings
+are folded into the default full run, so ``--strict`` covers them.
+``--format`` selects the findings output: ``text`` (human), ``json``,
+or ``github`` (workflow-annotation lines). The jaxpr pass needs >= 2
+visible devices, so an 8-device CPU host platform is provisioned before
+the first backend touch — same dance as ``tests/conftest.py`` — which
+makes the tool runnable on any dev box with ``JAX_PLATFORMS=cpu``, no
+TPU required.
 """
 
 from __future__ import annotations
@@ -75,6 +79,11 @@ def main(argv: list[str] | None = None) -> int:
                         help="findings output format (default: text)")
     parser.add_argument("--json", action="store_true", dest="as_json",
                         help="alias for --format json")
+    parser.add_argument("--protocol", action="store_true",
+                        help="cross-rank protocol pass only: P300-P303 "
+                             "over the drill/fixture PipelineSpec surface "
+                             "plus the AST P304 port-discipline lint "
+                             "(no tracing, no device mesh)")
     parser.add_argument("--cost", action="store_true",
                         help="emit the static comm/HBM cost table and "
                              f"write {COST_REPORT_PATH}")
@@ -155,22 +164,40 @@ def main(argv: list[str] | None = None) -> int:
         hbm_budget_bytes = int(args.hbm_budget * 1e6)
 
     findings = []
-    if not args.skip_ast:
+    if args.protocol:
+        # Protocol-only mode: the schedule checks plus the P304 slice of
+        # the AST pass — no tracing, no jax, byte-deterministic.
         from tpudml.analysis.ast_pass import analyze_tree
+        from tpudml.analysis.protocol import analyze_protocol_surface
 
         roots = args.paths or [r for r in ("tpudml", "tasks", "tools")
                                if os.path.isdir(r)]
-        findings.extend(analyze_tree(roots))
-    if not args.skip_jaxpr:
-        _provision_devices()
-        from tpudml.analysis.entrypoints import analyze_entrypoints
+        findings.extend(analyze_protocol_surface())
+        findings.extend(f for f in analyze_tree(roots)
+                        if f.rule == "P304")
+    else:
+        if not args.skip_ast:
+            from tpudml.analysis.ast_pass import analyze_tree
 
-        findings.extend(analyze_entrypoints(names, hbm_budget_bytes))
-    if args.plan:
-        _provision_devices()
-        from tpudml.plan import load_plan, plan_drift_findings
+            roots = args.paths or [r for r in ("tpudml", "tasks", "tools")
+                                   if os.path.isdir(r)]
+            findings.extend(analyze_tree(roots))
+        if not args.skip_jaxpr:
+            _provision_devices()
+            from tpudml.analysis.entrypoints import analyze_entrypoints
 
-        findings.extend(plan_drift_findings(load_plan(args.plan)))
+            findings.extend(analyze_entrypoints(names, hbm_budget_bytes))
+        if not args.skip_ast and not args.skip_jaxpr:
+            # Full runs also cover the protocol surface (cheap, jax-free)
+            # so --strict gates P300-P303 alongside everything else.
+            from tpudml.analysis.protocol import analyze_protocol_surface
+
+            findings.extend(analyze_protocol_surface())
+        if args.plan:
+            _provision_devices()
+            from tpudml.plan import load_plan, plan_drift_findings
+
+            findings.extend(plan_drift_findings(load_plan(args.plan)))
 
     from tpudml.analysis.allowlist import (
         load_allowlist,
@@ -183,7 +210,7 @@ def main(argv: list[str] | None = None) -> int:
     # Stale-entry detection needs the full finding surface: a filtered
     # run (subset of entrypoints/paths, or a skipped pass) legitimately
     # misses findings its allowlist entries cover.
-    full_run = (names is None and args.paths is None
+    full_run = (not args.protocol and names is None and args.paths is None
                 and not args.skip_jaxpr and not args.skip_ast)
     stale = unused_entries(findings, entries) if full_run else []
 

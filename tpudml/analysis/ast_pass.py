@@ -13,7 +13,13 @@ classes disappear (or worse, silently bake in) before a jaxpr exists:
         ``set_epoch``: every epoch replays epoch-0's shuffle order;
 - A204  host-clock deltas (``time.time``/``perf_counter``) around device
         work with no ``block_until_ready`` in the function: the clock
-        measures dispatch, not execution.
+        measures dispatch, not execution;
+- P304  port-reservation discipline (the protocol pass's one
+        source-level rule): a bind-and-hold reservation closed *before*
+        the round's wiring document is written (a squatter can take the
+        port in the window), or a locally-created listening socket that
+        neither escapes the scope nor reaches ``close()`` — leaked on
+        any error path.
 
 All checks are deliberately name-based heuristics scoped to one function
 at a time (module top-level counts as a function for scripts in
@@ -25,6 +31,7 @@ from __future__ import annotations
 
 import ast
 import os
+import re
 from typing import Iterable
 
 from tpudml.analysis.findings import Finding
@@ -216,6 +223,93 @@ class _FunctionLinter:
                 "the device work",
                 clock_calls[1])
 
+    # -- P304 ---------------------------------------------------------
+    def check_port_discipline(self) -> None:
+        """Two reservation-discipline hazards in one scope.
+
+        (a) a name assigned from a ``*.socket(...)`` call that has
+        ``.listen()`` called on it but never ``.close()``, and never
+        *escapes* (passed to a call, returned/yielded, aliased, or
+        stored into a container/attribute) — leaked on any error path;
+        (b) ``close()`` on a hold/reservation-named socket (directly or
+        through a for-loop over a matching name) at a line *before* the
+        scope's ``write_wiring``-style call — the bind-and-hold defense
+        is void for the window between release and commit.
+        """
+        created: set[str] = set()
+        listening: set[str] = set()
+        closed: set[str] = set()
+        escaped: set[str] = set()
+        listen_nodes: dict[str, ast.AST] = {}
+        aliases: dict[str, str] = {}  # loop var -> iterated name
+        hold_close: ast.AST | None = None
+        wiring_line: int | None = None
+
+        def names_in(expr: ast.AST) -> Iterable[str]:
+            for n in ast.walk(expr):
+                if isinstance(n, ast.Name):
+                    yield n.id
+
+        for node in self._ordered_nodes():
+            if (isinstance(node, ast.For)
+                    and isinstance(node.target, ast.Name)
+                    and isinstance(node.iter, ast.Name)):
+                aliases[node.target.id] = node.iter.id
+            if isinstance(node, ast.Assign):
+                plain = all(isinstance(t, ast.Name) for t in node.targets)
+                if (plain and len(node.targets) == 1
+                        and isinstance(node.value, ast.Call)):
+                    leaf = _attr_chain(node.value.func).rsplit(".", 1)[-1]
+                    if leaf == "socket":
+                        created.add(node.targets[0].id)
+                        continue
+                if not plain and not isinstance(node.value, ast.Call):
+                    # stored into an attribute/subscript/container:
+                    # escapes. (A Call value's receiver is NOT an escape
+                    # — its arguments are collected at the Call visit.)
+                    escaped.update(names_in(node.value))
+                elif plain and isinstance(node.value, ast.Name):
+                    escaped.add(node.value.id)  # aliased away
+            elif isinstance(node, (ast.Return, ast.Yield)):
+                if node.value is not None:
+                    escaped.update(names_in(node.value))
+            elif isinstance(node, ast.Call):
+                chain = _attr_chain(node.func)
+                leaf = chain.rsplit(".", 1)[-1] if chain else ""
+                recv = chain.rsplit(".", 1)[0] if "." in chain else ""
+                if leaf == "listen" and recv:
+                    listening.add(recv)
+                    listen_nodes.setdefault(recv, node)
+                elif leaf == "close" and recv:
+                    closed.add(recv)
+                    base = aliases.get(recv, recv)
+                    if hold_close is None and re.search(
+                            r"hold|reserv", base, re.IGNORECASE):
+                        hold_close = node
+                if "wiring" in leaf.lower() and wiring_line is None:
+                    wiring_line = node.lineno
+                for arg in (*node.args,
+                            *(kw.value for kw in node.keywords)):
+                    escaped.update(names_in(arg))
+
+        for name in sorted((created & listening) - closed - escaped):
+            self._emit(
+                "P304",
+                f"listener socket '{name}' is bound and listening but "
+                f"never reaches close() and never escapes this scope — "
+                f"leaked on any error path (close in a finally, or hand "
+                f"it off)",
+                listen_nodes[name])
+        if (hold_close is not None and wiring_line is not None
+                and hold_close.lineno < wiring_line):
+            self._emit(
+                "P304",
+                "bind-and-hold port reservation released before the "
+                "round's wiring is committed — a squatter can take the "
+                "port between release and spawn; keep the hold until "
+                "write_wiring returns",
+                hold_close)
+
     # ------------------------------------------------------------------
     def _ordered_nodes(self) -> Iterable[ast.AST]:
         """Every node in this scope in source order, NOT descending into
@@ -244,6 +338,7 @@ class _FunctionLinter:
         self.check_traced_control_flow()
         self.check_key_reuse()
         self.check_set_epoch()
+        self.check_port_discipline()
         if jax_in_scope:
             self.check_timing()
         return self.findings

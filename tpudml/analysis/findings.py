@@ -1,7 +1,8 @@
 """Finding model + rule registry for the static-analysis suite.
 
-Every rule has a stable id (J1xx = jaxpr pass, A2xx = AST pass), a
-severity, and a one-line contract. Findings carry file:line provenance —
+Every rule has a stable id (J1xx = jaxpr pass, A2xx = AST pass, P3xx =
+cross-rank protocol pass — P304 is AST-hosted), a severity, and a
+one-line contract. Findings carry file:line provenance —
 the jaxpr pass pulls it from equation ``source_info`` (so a hazard inside
 a traced step still points at the Python line that built it), the AST
 pass from the node. The committed allowlist (``allowlist.toml``) matches
@@ -65,6 +66,21 @@ RULES: dict[str, tuple[str, str]] = {
     "J118": (WARN, "traced collectives/HBM deviate >10% from the emitted "
                    "plan's predicted cost (the plan.json no longer "
                    "describes the program that runs)"),
+    "P300": (ERROR, "p2p frame sent with (edge, mb, tag, rows) that no peer "
+                    "schedule receives, or vice versa (boundary schedule "
+                    "asymmetry)"),
+    "P301": (ERROR, "wait-for cycle across ranks: the composed 1F1B/vote/"
+                    "collective schedules cannot all run to completion "
+                    "(cross-rank deadlock)"),
+    "P302": (ERROR, "ranks of one stage group issue different (op, axis, "
+                    "shape) collective sequences (cross-rank J102: gloo "
+                    "deadlocks, it does not diagnose)"),
+    "P303": (WARN, "schedule reaches a stage-group collective with no "
+                   "preceding drain vote (a membership event mid-step parks "
+                   "the group in gloo instead of draining)"),
+    "P304": (INFO, "port-reservation discipline: bind-and-hold released "
+                   "before the wiring is committed, or a listening socket "
+                   "leaked on an error path"),
     "A201": (WARN, "Python for/if over a traced (jnp/lax) value"),
     "A202": (WARN, "jax.random key consumed more than once without split"),
     "A203": (WARN, "epoch loop iterates a loader without set_epoch"),
@@ -112,6 +128,20 @@ HINTS: dict[str, str] = {
     "J118": "re-plan (python -m tpudml.plan) so plan.json matches the "
             "current program, or allowlist the entry with the reason the "
             "drift is intended",
+    "P300": "re-derive both sides from the same boundary_plan(spec, b) — "
+            "the (step, mb, edge) framing only works when sender and "
+            "receiver enumerate the identical transfer list",
+    "P301": "keep per-channel sends/recvs in plan-index order and the "
+            "vote+collective tail after all p2p (the StageWorker.run_step "
+            "order); check warmup_microbatches feeds enough rows downstream",
+    "P302": "trace every rank of the group from the same StageProgram — "
+            "per-rank model code must keep the collective sequence "
+            "identical (hoist divergent collectives out, as for J102)",
+    "P303": "vote on the DrainBarrier before entering the GroupReducer "
+            "allreduce so a dead peer drains the group at the barrier",
+    "P304": "hold port reservations until write_wiring has committed the "
+            "topology, and close (or hand off) listening sockets in a "
+            "finally block",
     "A201": "use lax.cond/lax.fori_loop/jnp.where, or materialize with "
             "float(...) first if this is host-side code",
     "A202": "key, sub = jax.random.split(key) before the second use",

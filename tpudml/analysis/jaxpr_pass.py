@@ -235,6 +235,35 @@ def _collective_signature(obj) -> tuple[tuple[str, tuple[str, ...]], ...]:
     return tuple(sig)
 
 
+def collective_shape_signature(obj) -> tuple:
+    """Ordered ``(prim, axes, operand shape)`` sequence of communicating
+    collectives, recursing through sub-jaxprs — the shape-carrying
+    variant of the J102 fingerprint that the protocol pass's P302 check
+    (``analysis/protocol.py``) compares across the ranks of one MPMD
+    stage group."""
+    jaxpr, _ = _inner_jaxpr(obj)
+    sig: list = []
+    for eqn in jaxpr.eqns:
+        # shard_map's rewrite pass emits numbered variants (psum -> psum2)
+        # of the same wire collective; normalize so signatures compare
+        # across pmap- and shard_map-traced ranks.
+        name = eqn.primitive.name
+        if name not in COMM_PRIMS and name.rstrip("0123456789") in COMM_PRIMS:
+            name = name.rstrip("0123456789")
+        if name in COMM_PRIMS:
+            shape = ()
+            if eqn.invars:
+                shape = tuple(getattr(eqn.invars[0].aval, "shape", ()))
+            sig.append((
+                name,
+                tuple(sorted(_eqn_axes(eqn))),
+                shape,
+            ))
+        for sub, _extra in _sub_jaxprs(eqn):
+            sig.extend(collective_shape_signature(sub))
+    return tuple(sig)
+
+
 def _check_upcasts(jaxpr, entrypoint: str, findings: list[Finding]) -> None:
     """J104 within one jaxpr level: convert_element_type bf16→f32 whose
     result has a non-accumulating direct consumer."""

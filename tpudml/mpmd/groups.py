@@ -21,6 +21,14 @@ The same membership machinery drives formation and teardown:
   ``drain_s{S}_r{R}.json`` marker into the round dir and exits 0 — so
   the victim is always the unique rank with a non-zero rc, and drained
   ranks are never mistaken for failures;
+- **pre-launch protocol gate** — before any round spawns, the static
+  cross-rank protocol checker (``tpudml/analysis/protocol.py``) runs
+  over the round's ``PipelineSpec`` — the initial spec and every
+  ``replace_pipeline`` result alike. Error-severity findings (P300
+  boundary asymmetry, P301 wait-for cycles, P302 collective-sequence
+  divergence) refuse the launch with machine-readable receipts
+  (``protocol_report.json`` in the run dir, ``stop_reason=
+  "protocol_rejected"``) instead of a hung drill burning its timeout;
 - **re-mesh in place** — the PR 16 ``Replanner`` is consulted
   fail-open at the surviving world, the pipeline shrinks via
   :func:`~tpudml.mpmd.spec.replace_pipeline` (``StageQuorumError``
@@ -206,6 +214,7 @@ class MPMDReformRecord:
 class MPMDResult:
     records: list = field(default_factory=list)
     replans: list = field(default_factory=list)
+    protocol: list = field(default_factory=list)  # per-round gate receipts
     success: bool = False
     total_elapsed_s: float = 0.0
     stop_reason: str = ""
@@ -222,6 +231,7 @@ class MPMDResult:
         return {
             "records": [r.to_dict() for r in self.records],
             "replans": [dict(r) for r in self.replans],
+            "protocol": [dict(r) for r in self.protocol],
             "success": self.success,
             "total_elapsed_s": self.total_elapsed_s,
             "stop_reason": self.stop_reason,
@@ -263,7 +273,8 @@ class MPMDController:
     def __init__(self, cmd, pipeline: PipelineSpec,
                  spec: ClusterSpec | None = None, *,
                  run_dir, ckpt_dir, max_reforms: int = 2,
-                 replanner=None, victim_rc: int | None = None, sink=None):
+                 replanner=None, victim_rc: int | None = None, sink=None,
+                 protocol_checker=None):
         self.cmd = list(cmd)
         self.pipeline = pipeline
         self.spec = (dataclasses.replace(spec) if spec is not None
@@ -278,6 +289,52 @@ class MPMDController:
         # fault injector's exit code.
         self.victim_rc = victim_rc
         self.sink = sink
+        # PipelineSpec -> list[Finding]; defaults to the static protocol
+        # analyzer. Injectable so tests can force a rejection without
+        # constructing a genuinely broken (hence unconstructible) spec.
+        self.protocol_checker = protocol_checker
+
+    # ---------------------------------------------------- protocol gate
+
+    def _check_protocol(self, pipeline: PipelineSpec, rnd: int,
+                        res: MPMDResult) -> bool:
+        """Run the cross-rank protocol checker on the spec about to be
+        spawned; append the receipt (clean or not) and keep the run
+        dir's ``protocol_report.json`` current. Returns False — refuse
+        to launch — on any error-severity finding."""
+        checker = self.protocol_checker
+        if checker is None:
+            from tpudml.analysis.protocol import analyze_pipeline
+
+            def checker(p):
+                return analyze_pipeline(p, entrypoint=f"round{rnd}")
+        findings = checker(pipeline)
+        errors = [f for f in findings
+                  if getattr(f, "severity", "error") == "error"]
+        res.protocol.append({
+            "round": rnd,
+            "pipeline": pipeline.to_dict(),
+            "ok": not errors,
+            "findings": [
+                {
+                    "rule": f.rule,
+                    "severity": f.severity,
+                    "message": f.message,
+                    "file": f.file,
+                    "line": f.line,
+                    "entrypoint": f.entrypoint,
+                }
+                for f in findings
+            ],
+        })
+        report = {
+            "version": 1,
+            "ok": all(r["ok"] for r in res.protocol),
+            "checks": res.protocol,
+        }
+        (self.run_dir / "protocol_report.json").write_text(
+            json.dumps(report, indent=2, sort_keys=True) + "\n")
+        return not errors
 
     # ------------------------------------------------------------- ports
 
@@ -345,6 +402,18 @@ class MPMDController:
         self.run_dir.mkdir(parents=True, exist_ok=True)
 
         for rnd in range(self.max_reforms + 1):
+            # Pre-launch gate: the initial spec AND every re-meshed spec
+            # must pass the static protocol checks before any process
+            # (or port reservation) is spent on them.
+            if not self._check_protocol(pipeline, rnd, res):
+                out.write(
+                    f"[mpmd] round {rnd}: protocol checker rejected the "
+                    f"pipeline spec — refusing to launch (receipts in "
+                    f"protocol_report.json)\n"
+                )
+                out.flush()
+                res.stop_reason = "protocol_rejected"
+                break
             holds, coord, boundary, ctl = self._round_ports(
                 pipeline, used_ports
             )
