@@ -393,6 +393,74 @@ def bench_transformer(on_tpu: bool, large: bool = False) -> dict:
         "flops_per_step_xla": round(flops_xla) if flops_xla else None,
         **_mfu_fields(flops, sec_fori, sec_synced, sec_pipe,
                       _peak_flops(jax.devices()[0]), fori_runs),
+        **_residual_fields(cfg, batch, seq_len, on_tpu),
+    }
+
+
+def _residual_fields(cfg, batch, seq_len, on_tpu) -> dict:
+    """Round-20 per-residual breakdown for the flagship row: fori-timed
+    ms/step of the two non-MXU residual sites this round fused — the
+    decode head tail (``ops.fused_decode_head`` at the flagship head
+    shape) and one step's worth of block junctions
+    (``ops.fused_attn_junction`` chained ``num_layers`` deep) — so
+    BENCH_r06+ tracks the residuals shrinking next to ``mfu``.
+    ``exposed_comm_ms`` is structurally 0.0 on the single-chip flagship
+    row; the multi-chip rows (``--zero1``) carry the measured
+    exposed-vs-hidden attribution from ``overlap_report``, and the
+    planner's per-candidate split lives in plan.json."""
+    import numpy as np
+
+    from tpudml.ops.decode_head import fused_decode_head
+    from tpudml.ops.junction_kernel import fused_attn_junction
+
+    d, heads, L = cfg["embed_dim"], cfg["num_heads"], cfg["num_layers"]
+    v, dh = cfg["vocab_size"], d // heads
+    rng = np.random.default_rng(0)
+    f32 = lambda *s: jnp.asarray(rng.normal(size=s).astype(np.float32))
+    ks = ((8, 40) if on_tpu else (1, 3))
+    reps = 3 if on_tpu else 1
+
+    # Head tail at the decode shape: [batch, d] features into the [d, V]
+    # head. The 1e-20·carry term threads a loop-carried dependency so
+    # fori iterations cannot collapse; it never changes the measured math.
+    h, w = f32(batch, d), f32(d, v) * 0.1
+
+    def head_body(ts, h, w):
+        _, _, lse = fused_decode_head(h + ts * 1e-20, w)
+        out = jnp.sum(lse)
+        return out, out
+
+    head_s, head_runs = _time_fori(head_body, jnp.zeros(()), (h, w), *ks,
+                                   reps=reps)
+
+    # One step's junctions: L fused attention junctions chained through
+    # the residual stream (each layer's s feeds the next), the train
+    # trunk's per-step junction count.
+    q, k, vv = f32(batch, seq_len, heads, dh), f32(batch, seq_len, heads, dh), \
+        f32(batch, seq_len, heads, dh)
+    wo, bo = f32(d, d) * 0.1, f32(d)
+    g, b2 = f32(d), f32(d)
+
+    def junction_body(ts, q, r):
+        r = r + ts * 1e-20
+        y = r
+        for _ in range(L):
+            r, y = fused_attn_junction(q, k, vv, r, wo, bo, g, b2)
+        out = jnp.sum(y)
+        return out, out
+
+    junc_s, junc_runs = _time_fori(
+        junction_body, jnp.zeros(()), (q, f32(batch, seq_len, d)), *ks,
+        reps=reps)
+
+    return {
+        "head_ms": round(head_s * 1e3, 4),
+        "junction_ms": round(junc_s * 1e3, 4),
+        "exposed_comm_ms": 0.0,  # single-chip row: no wire to expose
+        "residual_runs_ms": {
+            "head": [round(s * 1e3, 4) for s in sorted(head_runs)],
+            "junction": [round(s * 1e3, 4) for s in sorted(junc_runs)],
+        },
     }
 
 

@@ -382,6 +382,111 @@ def test_j117_silent_on_real_paged_and_spec_steps():
     assert [f for f in spec if f.rule in ("J110", "J117")] == [], spec
 
 
+def test_j119_unfused_tail_fires_and_fused_is_silent():
+    """J119 fires once on the stock dense decode step (materialized
+    [B, V] logits + separate argmax tail) and stays silent — J110 too —
+    when ServeConfig(fused_head=True) routes the tail through the fused
+    head marker, whose INTERNAL argmax the scan must skip."""
+    from tpudml.models import TransformerLM
+    from tpudml.serve import ServeConfig, ServingEngine
+
+    lm = TransformerLM(vocab_size=32, embed_dim=16, num_heads=2,
+                       num_layers=2, max_len=16, rope=True)
+    params, _ = lm.init(jax.random.key(0))
+
+    def args(eng):
+        return (params, eng.caches, np.zeros(2, np.int32),
+                np.zeros(2, np.int32))
+
+    plain = ServingEngine(
+        lm, params, ServeConfig(slots=2, max_len=16, prefill_chunk=4))
+    bad = analyze_callable(plain._decode, args(plain), "j119-unfused")
+    fired = [f for f in bad if f.rule == "J119"]
+    assert len(fired) == 1, bad  # one finding per marked program
+    assert "full-vocab" in fired[0].message and fired[0].line > 0
+    assert fired[0].hint
+
+    fused = ServingEngine(
+        lm, params,
+        ServeConfig(slots=2, max_len=16, prefill_chunk=4, fused_head=True))
+    good = analyze_callable(fused._decode, args(fused), "j119-fused")
+    assert [f for f in good if f.rule in ("J110", "J119")] == [], good
+
+
+def test_j119_fires_on_paged_tail_too():
+    """The paged decode step's tail is the same unfused argmax — J119
+    covers every decode-marked program, not just the dense one."""
+    from tpudml.models import TransformerLM
+    from tpudml.serve import ServeConfig, ServingEngine
+
+    lm = TransformerLM(vocab_size=32, embed_dim=16, num_heads=2,
+                       num_layers=2, max_len=16, rope=True)
+    params, _ = lm.init(jax.random.key(0))
+    eng = ServingEngine(
+        lm, params,
+        ServeConfig(slots=2, max_len=16, prefill_chunk=4,
+                    cache_layout="paged", page_size=4, num_pages=9))
+    table = np.zeros((2, eng.cfg.max_pages), np.int32)
+    found = analyze_callable(
+        eng._decode,
+        (params, eng.caches, table, np.zeros(2, np.int32),
+         np.zeros(2, np.int32)),
+        "j119-paged")
+    assert len([f for f in found if f.rule == "J119"]) == 1, found
+
+
+def test_j119_overlap_claim_verified_against_marker():
+    """The overlap half: a plan whose winner claims ``tp_overlap`` must
+    see the TP_OVERLAP_NAME pjit in the traced program — a program
+    routed through tp_overlap_matmul passes, a plain matmul program
+    fires, and an unclaiming plan checks nothing."""
+    from jax.sharding import PartitionSpec as P
+
+    from tpudml.core.config import MeshConfig
+    from tpudml.core.dist import make_mesh
+    from tpudml.parallel.overlap import tp_overlap_matmul
+    from tpudml.parallel.sharding import shard_map_fn
+
+    mesh = make_mesh(MeshConfig({"model": 4}), jax.devices()[:4])
+    x = jnp.ones((8, 16), jnp.float32)
+    w = jnp.ones((16, 8), jnp.float32)
+
+    def claiming(key):
+        return {"winner": {"candidate": {"tp_overlap": True, "key": key}}}
+
+    overlapped = jax.jit(shard_map_fn(
+        lambda x, w: tp_overlap_matmul(x, w, axis_name="model"),
+        mesh, in_specs=(P(), P(None, "model")), out_specs=P()))
+    ok = analyze_callable(
+        overlapped, (x, w), "j119-overlap-ok", plan=claiming("t1"))
+    assert [f for f in ok if f.rule == "J119"] == [], ok
+
+    plain = jax.jit(shard_map_fn(
+        lambda x, w: jax.lax.psum(x @ w, "model"),
+        mesh, in_specs=(P(), P(None, "model")), out_specs=P()))
+    bad = analyze_callable(
+        plain, (x, w), "j119-overlap-bad", plan=claiming("t1"))
+    fired = [f for f in bad if f.rule == "J119"]
+    assert len(fired) == 1 and "tp_overlap" in fired[0].message, bad
+
+    unclaiming = {"winner": {"candidate": {"tp_overlap": False, "key": "t0"}}}
+    silent = analyze_callable(
+        plain, (x, w), "j119-no-claim", plan=unclaiming)
+    assert [f for f in silent if f.rule == "J119"] == [], silent
+
+
+def test_j119_marker_names_match_modules():
+    """Drift pins for the fused-head and overlap markers J119 keys on —
+    same discipline as the J107/J110/J117 pins."""
+    from tpudml.analysis import jaxpr_pass
+    from tpudml.ops import decode_head
+    from tpudml.parallel import overlap
+
+    assert set(jaxpr_pass.FUSED_HEAD_NAMES) == {
+        decode_head.FUSED_HEAD_MARKER, decode_head.FUSED_HEAD_INT8_MARKER}
+    assert jaxpr_pass.TP_OVERLAP_NAME == overlap.TP_OVERLAP_MARKER
+
+
 def test_j100_trace_failure_becomes_finding():
     def broken(x):
         return x + jnp.ones((x.shape[0] + 1,))  # shape mismatch at trace

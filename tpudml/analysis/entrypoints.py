@@ -379,6 +379,31 @@ def build_serve_paged_decode() -> list[Program]:
     )]
 
 
+def build_serve_fused() -> list[Program]:
+    """The dense decode step with the fused head tail
+    (``ServeConfig(fused_head=True)``) — the surface J119's tail check
+    guards. The fused step must trace J119-silent: its greedy pick lives
+    INSIDE the ``_fused_decode_head`` marker pjit, which the scan skips;
+    the plain ``serve_decode`` entrypoint above is the rule's
+    (allowlisted) firing fixture."""
+    import jax
+    from tpudml.serve import ServeConfig, ServingEngine
+
+    lm = _tiny_lm(rope=True, num_kv_heads=1)
+    params, _ = lm.init(jax.random.key(0))
+    eng = ServingEngine(
+        lm, params,
+        ServeConfig(slots=2, max_len=8, prefill_chunk=4, fused_head=True),
+    )
+    np = _np()
+    tokens = np.zeros(2, np.int32)
+    pos = np.zeros(2, np.int32)
+    return [Program(
+        "serve_fused", eng._decode, (params, eng.caches, tokens, pos),
+        expects_donation=False,  # KiB-scale caches, like serve_decode
+    )]
+
+
 #: name -> builder; order is reporting order.
 ENTRYPOINTS: dict[str, Callable[[], list[Program]]] = {
     "task1_single": build_task1_single,
@@ -396,6 +421,7 @@ ENTRYPOINTS: dict[str, Callable[[], list[Program]]] = {
     "lm_bf16": build_lm_bf16,
     "serve_decode": build_serve_decode,
     "serve_paged_decode": build_serve_paged_decode,
+    "serve_fused": build_serve_fused,
 }
 
 

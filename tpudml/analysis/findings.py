@@ -66,6 +66,11 @@ RULES: dict[str, tuple[str, str]] = {
     "J118": (WARN, "traced collectives/HBM deviate >10% from the emitted "
                    "plan's predicted cost (the plan.json no longer "
                    "describes the program that runs)"),
+    "J119": (WARN, "decode-marked program materializes the full-vocab "
+                   "logits row and argmaxes it outside the head matmul "
+                   "(the [B, V] tail round-trips HBM every token), or a "
+                   "program claims psum-overlapped TP matmuls without the "
+                   "overlap marker"),
     "P300": (ERROR, "p2p frame sent with (edge, mb, tag, rows) that no peer "
                     "schedule receives, or vice versa (boundary schedule "
                     "asymmetry)"),
@@ -128,6 +133,11 @@ HINTS: dict[str, str] = {
     "J118": "re-plan (python -m tpudml.plan) so plan.json matches the "
             "current program, or allowlist the entry with the reason the "
             "drift is intended",
+    "J119": "serve with ServeConfig(fused_head=True) so the head matmul, "
+            "greedy pick, and step stats run as one vocab-tiled program "
+            "(ops.fused_decode_head); for the overlap half, route the "
+            "claimed matmul through parallel.overlap.tp_overlap_matmul "
+            "(which carries the marker) or drop the claim",
     "P300": "re-derive both sides from the same boundary_plan(spec, b) — "
             "the (step, mb, edge) framing only works when sender and "
             "receiver enumerate the identical transfer list",

@@ -124,6 +124,21 @@ SERVE_DECODE_NAME = "_serve_decode_step"
 # SPEC_DECODE_MARKER (tpudml/serve/spec.py); pinned by test_analysis.
 PAGED_DECODE_NAMES = ("_serve_paged_decode_step", "_serve_spec_decode_step")
 
+# The fused decode-tail dispatchers (head matmul + greedy pick + step
+# stats as one vocab-tiled program) are jitted under these marker names
+# (J119's tail check skips their bodies — their internal argmax IS the
+# fused pick). Mirror FUSED_HEAD_MARKER / FUSED_HEAD_INT8_MARKER in
+# tpudml/ops/decode_head.py; pinned by test_analysis.
+FUSED_HEAD_NAMES = ("_fused_decode_head", "_fused_decode_head_int8")
+
+# The chunked psum-overlapped TP matmul is jitted under this marker name
+# (J119's overlap-claim check). Mirrors TP_OVERLAP_MARKER in
+# tpudml/parallel/overlap.py; pinned by test_analysis.
+TP_OVERLAP_NAME = "_tp_overlap_matmul"
+
+# Decode-marked pjit names whose bodies J119's unfused-tail check scans.
+_DECODE_TAIL_NAMES = (SERVE_DECODE_NAME,) + PAGED_DECODE_NAMES
+
 # Primitives a last-dim sharding survives on the way from a shard_map
 # body invar to the fused head's w operand (J107 taint propagation).
 _LASTDIM_PRESERVING = frozenset({"convert_element_type", "copy"})
@@ -427,9 +442,14 @@ def _find_wide_softmax_exp(obj):
     keeps BOTH trailing dims > 1 — the [.., T, T] attention-probability
     tensor of a full-sequence softmax. A cache-reading decode step's
     softmax runs on [B, H, 1, L] scores (one query row per emitted
-    token), so its exp never matches."""
+    token), so its exp never matches. Fused-head marker bodies are
+    skipped: their lse statistics exp over [B, V_tile] vocab columns,
+    not attention scores."""
     jaxpr, _ = _inner_jaxpr(obj)
     for eqn in jaxpr.eqns:
+        if (eqn.primitive.name == "pjit"
+                and str(eqn.params.get("name", "")) in FUSED_HEAD_NAMES):
+            continue
         if eqn.primitive.name == "exp":
             shape = tuple(
                 getattr(getattr(eqn.invars[0], "aval", None), "shape", ())
@@ -527,6 +547,80 @@ def _check_full_pool_gather(eqn, entrypoint: str,
         f"HBM, not the slot's table window",
         file=f, line=ln, entrypoint=entrypoint,
     ))
+
+
+def _scan_unfused_tail(obj, dot_dims: set, hits: list) -> None:
+    """Recursive in-order scan for J119's tail half: collect the last
+    output dim of every ``dot_general`` seen so far, and record any
+    ``argmax`` that reduces its operand's LAST axis when that axis's
+    size matches a collected matmul output dim — the greedy pick
+    consuming a materialized full-width logits row. Sub-pjits named in
+    ``FUSED_HEAD_NAMES`` are skipped wholesale: their internal argmax is
+    the fused epilogue, not a round-trip."""
+    jaxpr, _ = _inner_jaxpr(obj)
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if (name == "pjit"
+                and str(eqn.params.get("name", "")) in FUSED_HEAD_NAMES):
+            continue
+        if name == "dot_general":
+            for ov in eqn.outvars:
+                shape = tuple(getattr(getattr(ov, "aval", None), "shape", ()))
+                if shape:
+                    dot_dims.add(shape[-1])
+        if name == "argmax":
+            shape = tuple(
+                getattr(getattr(eqn.invars[0], "aval", None), "shape", ())
+            )
+            axes = tuple(eqn.params.get("axes", ()))
+            if (shape and axes and axes == (len(shape) - 1,)
+                    and shape[-1] > 1 and shape[-1] in dot_dims):
+                hits.append((eqn, shape))
+        for sub, _extra in _sub_jaxprs(eqn):
+            _scan_unfused_tail(sub, dot_dims, hits)
+
+
+def _check_unfused_decode_tail(eqn, entrypoint: str,
+                               findings: list[Finding]) -> None:
+    """J119 (tail half) for one decode-marked pjit equation: the step
+    materializes the full-vocab logits row out of the head matmul and
+    argmaxes it as a separate reduction — a [B, V] HBM round-trip per
+    emitted token that the fused head (``ops.fused_decode_head``) folds
+    into the matmul's epilogue. Vocab is identified as any matmul output
+    last-dim seen earlier in the same marked body (the head is the only
+    matmul whose output width the pick reduces over). One finding per
+    marked program."""
+    body = eqn.params.get("jaxpr")
+    if body is None:
+        return
+    hits: list = []
+    _scan_unfused_tail(body, set(), hits)
+    if not hits:
+        return
+    am_eqn, shape = hits[0]
+    f, ln = _src_loc(am_eqn)
+    findings.append(Finding(
+        "J119",
+        f"decode step materializes the full-vocab logits and argmaxes "
+        f"them outside the head matmul: argmax over {list(shape)} whose "
+        f"reduced dim matches a matmul output width — the [B, V] tail "
+        f"round-trips HBM every emitted token",
+        file=f, line=ln, entrypoint=entrypoint,
+    ))
+
+
+def _contains_pjit_named(obj, names: tuple) -> bool:
+    """True if any (recursively nested) pjit equation carries one of the
+    marker ``names`` — J119's overlap-claim verification."""
+    jaxpr, _ = _inner_jaxpr(obj)
+    for eqn in jaxpr.eqns:
+        if (eqn.primitive.name == "pjit"
+                and str(eqn.params.get("name", "")) in names):
+            return True
+        for sub, _extra in _sub_jaxprs(eqn):
+            if _contains_pjit_named(sub, names):
+                return True
+    return False
 
 
 def _scan_update_collectives(obj, axes: tuple[str, ...], acc: dict) -> None:
@@ -770,6 +864,8 @@ def _walk(obj, bound: frozenset[str], entrypoint: str,
             _check_cacheless_decode(eqn, entrypoint, findings)
         if name == "pjit" and str(eqn.params.get("name", "")) in PAGED_DECODE_NAMES:
             _check_full_pool_gather(eqn, entrypoint, findings)
+        if name == "pjit" and str(eqn.params.get("name", "")) in _DECODE_TAIL_NAMES:
+            _check_unfused_decode_tail(eqn, entrypoint, findings)
         if name == "shard_map":
             seed = _fused_xent_seed(eqn)
             if seed:
@@ -828,6 +924,19 @@ def analyze_closed_jaxpr(
             findings.extend(check_hbm_budget(cost, hbm_budget_bytes))
         if plan is not None:
             findings.extend(check_plan_drift(cost, plan))
+    if plan is not None:
+        cand = ((plan.get("winner") or {}).get("candidate") or {})
+        if cand.get("tp_overlap") and not _contains_pjit_named(
+                closed, (TP_OVERLAP_NAME,)):
+            findings.append(Finding(
+                "J119",
+                f"plan winner {cand.get('key', '?')} claims psum-"
+                f"overlapped TP matmuls (tp_overlap) but the traced "
+                f"program carries no {TP_OVERLAP_NAME} marker — the wire "
+                f"time the plan priced as hidden is actually exposed on "
+                f"the critical path",
+                entrypoint=entrypoint,
+            ))
     return findings
 
 

@@ -24,8 +24,9 @@ consult, all optional: ``engine`` (one of ``dp / zero1 / fsdp / tp /
 fsdp_tp / pp_dp / ep``), ``mesh`` (axis-name → size dict), ``zero1``,
 ``zero1_overlap``, ``accum_steps``, ``fused_xent``, ``save_scores``,
 ``measure_comm``, ``custom_loss``, ``aggregation``, ``dropout``,
-``moe_experts``, ``grad_clip``, ``schedule``, ``serve_tp``,
-``serve_cache_layout``, ``serve_spec_k``, ``serve_weight_quant``,
+``moe_experts``, ``grad_clip``, ``schedule``, ``flash_attn``, ``impl``,
+``seq_sharded``, ``tp_overlap``, ``serve_tp``, ``serve_cache_layout``,
+``serve_spec_k``, ``serve_weight_quant``, ``serve_fused_head``,
 ``serve_fleet``, ``mpmd``, ``serve``.  Entries with ``when=None``
 are constructor-level invariants the planner can never generate (e.g.
 handing a pre-wrapped ZeRO1 optimizer to a non-zero1 engine) — they
@@ -203,6 +204,47 @@ _ENTRIES = (
         owner="tasks.task5_longcontext",
         message="--parallel ep does not support --dropout",
         when=lambda c: _g(c, "engine") == "ep" and bool(_g(c, "dropout")),
+    ),
+    Capability(
+        key="train_flash_attn_dense",
+        owner="tpudml.parallel.dp / mp",
+        message=(
+            "flash_attn swaps the dense causal trunk onto the Pallas "
+            "flash kernel; it requires impl='full' (ring/ulysses trunks "
+            "already run fused sequence-sharded attention) and "
+            "seq_sharded=False"
+        ),
+        when=lambda c: bool(_g(c, "flash_attn"))
+        and (
+            _g(c, "impl", "full") != "full" or bool(_g(c, "seq_sharded"))
+        ),
+    ),
+    Capability(
+        key="tp_overlap_needs_model_axis",
+        owner="tpudml.parallel.overlap / tpudml.plan",
+        message=(
+            "tp_overlap chunks a row-sharded matmul against its psum; "
+            "without a model axis of size > 1 there is no reduce to "
+            "hide — run the unchunked matmul"
+        ),
+        when=lambda c: bool(_g(c, "tp_overlap"))
+        and _g(c, "mesh", {}).get("model", 1) <= 1,
+    ),
+    Capability(
+        key="serve_fused_head_dense",
+        owner="tpudml.serve.engine",
+        message=(
+            "fused_head folds the greedy pick into the head matmul "
+            "epilogue of the dense single-device decode step only: the "
+            "paged/spec steps consume full logits windows and TP "
+            "shards the head — run those unfused"
+        ),
+        when=lambda c: bool(_g(c, "serve_fused_head"))
+        and (
+            bool(_g(c, "serve_tp"))
+            or _g(c, "serve_cache_layout", "dense") != "dense"
+            or _g(c, "serve_spec_k", 0) > 0
+        ),
     ),
     Capability(
         key="serve_tp_paged_spec",

@@ -645,6 +645,23 @@ class TransformerLM(Module):
         logits = self._head()({k: params[k] for k in ("ln_f", "head")}, h)
         return logits[:, 0, :], new_caches
 
+    def apply_decode_features(self, params, caches, tokens, pos):
+        """One incremental decode step STOPPING AT THE FEATURES: embed →
+        cached blocks → final LayerNorm, without the vocab projection —
+        (features [B, d], updated caches). The input contract of the
+        fused decode head (``tpudml.ops.decode_head``), which consumes
+        features + head weights and never materializes the [B, V]
+        logits; the serving twin of ``apply_features``."""
+        self._serve_guard()
+        params = self._cast_params(params)
+        h = self._decode_embed(params, tokens, pos)
+        h, new_caches = self._serve_blocks(
+            params, caches, h,
+            lambda attn, p, cache, y: attn.apply_decode(p, cache, y, pos),
+        )
+        h = LayerNorm(self.embed_dim, dtype=self.dtype)(params["ln_f"], h)
+        return h[:, 0, :], new_caches
+
     def apply_decode_window(self, params, caches, tokens, pos):
         """Decode a window of Q consecutive tokens per slot over the
         dense cache: ``tokens`` [B, Q], first token at ``pos`` [B] →

@@ -11,6 +11,8 @@ from tpudml.ops.attention_kernel import (
     flash_block_grads,
     flash_forward_lse,
 )
+from tpudml.ops.decode_head import fused_decode_head, fused_decode_head_int8
+from tpudml.ops.junction_kernel import fused_attn_junction
 from tpudml.ops.layernorm_kernel import fused_layernorm
 from tpudml.ops.xent_kernel import linear_cross_entropy
 
@@ -18,6 +20,9 @@ __all__ = [
     "flash_attention",
     "flash_block_grads",
     "flash_forward_lse",
+    "fused_attn_junction",
+    "fused_decode_head",
+    "fused_decode_head_int8",
     "fused_layernorm",
     "linear_cross_entropy",
 ]

@@ -1,0 +1,114 @@
+"""Fused attention-block junction: flash attention + out-projection +
+residual add + LayerNorm as one differentiable unit.
+
+BASELINE.md round 7 put ~2.2 ms/step of the flagship's non-MXU residual
+at the block junctions — the seams where attention output meets the
+residual stream and the next norm, which XLA schedules as separate
+reduce-broken fusion chains. This module closes the seam by chaining
+the two existing Pallas kernels through the out-projection matmul under
+ONE named jit:
+
+    a      = flash_attention(q, k, v)          # ops/attention_kernel
+    h      = a.reshape(B, T, d) @ Wo + bo      # MXU epilogue
+    (s, y) = fused_add_layernorm(r, h, γ, β)   # ops/layernorm_kernel
+
+Both kernels carry full custom_vjp backwards (flash recompute-tiles,
+add+LN one-pass with the residual-cotangent merge), so differentiating
+the junction runs kernel backwards end to end — no reference-math
+recompute anywhere in the chain — while the matmul between them stays
+an ordinary MXU op XLA fuses into the surrounding epilogues. The named
+jit (``ATTN_JUNCTION_MARKER``) keeps the junction recognizable in any
+traced step for the analysis tracer, exactly the marker discipline of
+the fused xent and serve decode programs.
+
+Semantics match the unfused block composition
+``s = r + (attn(q,k,v) @ Wo + bo); y = LN(s)`` with the sum rounded to
+the stream dtype before the f32 statistics (the add+LN kernel's
+contract). Dispatch: each sub-kernel compiles on TPU and falls back to
+its reference math on other backends unless ``interpret=True`` forces
+the Pallas interpreter (tests).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from tpudml.ops.attention_kernel import flash_attention
+from tpudml.ops.layernorm_kernel import fused_add_layernorm
+
+
+def _attn_junction(q, k, v, r, wo, bo, scale, bias, causal, eps, interpret):
+    b, t, h, dh = q.shape
+    a = flash_attention(q, k, v, causal=causal, interpret=interpret)
+    proj = jax.lax.dot_general(
+        a.reshape(b, t, h * dh), wo, (((2,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(r.dtype) + bo.astype(r.dtype)
+    return fused_add_layernorm(
+        r, proj, scale, bias, eps=eps, interpret=interpret
+    )
+
+
+ATTN_JUNCTION_MARKER = _attn_junction.__name__
+
+_attn_junction_jit = jax.jit(_attn_junction, static_argnums=(8, 9, 10))
+
+
+def fused_attn_junction(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    r: jax.Array,
+    wo: jax.Array,
+    bo: jax.Array,
+    scale: jax.Array,
+    bias: jax.Array,
+    *,
+    causal: bool = True,
+    eps: float = 1e-5,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """The attention junction as one fused unit (module docstring).
+
+    ``q``/``k``/``v`` [B, T, H, D] (post-QKV-projection heads), ``r``
+    [B, T, d] the incoming residual stream (d = H·D), ``wo`` [d, d] /
+    ``bo`` [d] the attention out-projection, ``scale``/``bias`` [d] the
+    junction norm's affine. Returns ``(s, y)``: the new residual stream
+    ``s = r + proj`` and ``y = LayerNorm(s)`` — the same contract as
+    ``fused_add_layernorm``, so the deferred-trunk composition pattern
+    applies unchanged. Fully differentiable: the backward chains the
+    add+LN and flash kernel vjps through the projection transpose."""
+    b, t, h, dh = q.shape
+    d = h * dh
+    if r.shape != (b, t, d):
+        raise ValueError(f"r {r.shape} must be {(b, t, d)}")
+    if wo.shape != (d, d):
+        raise ValueError(f"wo {wo.shape} must be {(d, d)}")
+    return _attn_junction_jit(
+        q, k, v, r, wo, bo, scale, bias, causal, eps, interpret
+    )
+
+
+def reference_attn_junction(q, k, v, r, wo, bo, scale, bias, *,
+                            causal: bool = True, eps: float = 1e-5):
+    """Differentiable unfused reference for the parity tests: the exact
+    block-junction math (reference attention, rounded residual sum,
+    f32 LN statistics) the fused unit must reproduce grad-exactly."""
+    from tpudml.nn.attention import dot_product_attention
+
+    b, t, h, dh = q.shape
+    a = dot_product_attention(q, k, v, causal=causal)
+    proj = jax.lax.dot_general(
+        a.reshape(b, t, h * dh), wo, (((2,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(r.dtype) + bo.astype(r.dtype)
+    s = r + proj
+    sf = s.astype(jnp.float32)
+    m = jnp.mean(sf, axis=-1, keepdims=True)
+    var = jnp.maximum(
+        jnp.mean(jnp.square(sf), axis=-1, keepdims=True) - jnp.square(m), 0.0
+    )
+    y = (sf - m) * jax.lax.rsqrt(var + eps)
+    y = y * scale.astype(jnp.float32) + bias.astype(jnp.float32)
+    return s, y.astype(s.dtype)

@@ -102,6 +102,7 @@ class DataParallel:
         zero1_overlap: bool = False,
         sentinel: bool | dict = False,
         obs: bool | Tracer = False,
+        flash_attn: bool = False,
     ):
         if save_scores and not fused_xent:
             reject("save_scores_needs_fused_xent")
@@ -137,6 +138,21 @@ class DataParallel:
                     f"world={optimizer.world}) does not match the engine's "
                     f"{axis_name!r} axis of size {mesh.shape[axis_name]}"
                 )
+        # flash_attn: swap the dense causal attention trunk onto the
+        # Pallas flash kernel (ops/attention_kernel.py) via the model's
+        # own ``impl`` dispatch — a capability-table row, not an ad-hoc
+        # flag: the rejection condition (non-"full" trunks, which already
+        # run their own fused sequence-sharded attention) lives in ONE
+        # place shared with the planner's candidate pruning.
+        self.flash_attn = flash_attn
+        if flash_attn:
+            import dataclasses
+
+            if getattr(model, "impl", None) != "full" or getattr(
+                model, "seq_sharded", False
+            ):
+                reject("train_flash_attn_dense")
+            model = dataclasses.replace(model, impl="flash")
         self.model = model
         self.optimizer = optimizer
         self.mesh = mesh

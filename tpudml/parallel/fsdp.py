@@ -116,6 +116,7 @@ class FSDP(GSPMDParallel):
         save_scores: bool | None = None,
         sentinel: bool | dict = False,
         obs=False,
+        flash_attn: bool = False,
     ):
         if axis_name not in mesh.shape:
             raise ValueError(
@@ -138,4 +139,5 @@ class FSDP(GSPMDParallel):
             save_scores=save_scores,
             sentinel=sentinel,
             obs=obs,
+            flash_attn=flash_attn,
         )

@@ -183,11 +183,25 @@ class GSPMDParallel:
         save_scores: bool | None = None,
         sentinel: bool | dict = False,
         obs: bool | Tracer = False,
+        flash_attn: bool = False,
     ):
         if save_scores and not fused_xent:
             reject("save_scores_needs_fused_xent")
         if fused_xent and (accum_steps != 1 or loss is not softmax_cross_entropy):
             reject("gspmd_fused_xent_accum")
+        # flash_attn: run the dense causal trunk on the Pallas flash
+        # kernel (same capability row as the DP engine — GSPMD shards
+        # batch/heads, never the softmax's sequence axis, so the kernel
+        # composes with TP/FSDP rules unchanged).
+        self.flash_attn = flash_attn
+        if flash_attn:
+            import dataclasses
+
+            if getattr(model, "impl", None) != "full" or getattr(
+                model, "seq_sharded", False
+            ):
+                reject("train_flash_attn_dense")
+            model = dataclasses.replace(model, impl="flash")
         self.model = model
         self.optimizer = optimizer
         # In-graph step sentinel (tpudml.resilience): under jit/GSPMD the

@@ -1,0 +1,97 @@
+"""Collective-matmul overlap: chunked psum-overlapped TP matmuls.
+
+ROADMAP item 4(c). A tensor-parallel block ends its attention and MLP
+branches with a row-sharded matmul whose partial products must be
+psum-merged across the model axis — in the naive composition the whole
+[rows, d] product finishes before the allreduce starts, so the wire
+time is fully EXPOSED on the step's critical path. The overlap form
+(arXiv 2204.06514's collective-matmul placement) splits the row axis
+into K chunks and reduces each chunk's partial product as soon as it
+exists, so chunk i's allreduce rides under chunk i+1's matmul and only
+the LAST chunk's reduce (1/K of the wire bytes) stays exposed.
+
+This module is the runnable shard_map-level primitive plus the marker
+contract; the *placement* decision lives in the planner —
+``plan/score.py`` prices a ``tp_overlap`` candidate with the
+exposed-vs-hidden wire split (hidden (K−1)/K, exposed 1/K) and
+``plan/space.py`` enumerates it per TP-capable mesh, pruned by the
+capability table's ``tp_overlap_needs_model_axis`` row.
+
+The function is jitted under a NAMED inner jit (``TP_OVERLAP_MARKER``)
+so any step claiming overlapped TP matmuls carries a recognizable pjit
+equation — analysis rule J119's overlap check verifies the claim
+against the marker, the same discipline as the fused xent/decode
+markers. XLA inlines the marker at lowering; the chunked loop itself is
+what lets the latency-hiding scheduler start reduce i during matmul
+i+1.
+
+Exactness: ``concat_i(psum(x_i @ w)) == psum(x @ w)`` — the chunk split
+is over rows, which the reduce never mixes; pinned by the parity tests
+under TP and FSDP×TP meshes in both value and gradient.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from tpudml.capabilities import reject
+
+# Default chunk count: 4 hides 3/4 of the reduce behind compute while
+# keeping per-chunk matmuls MXU-shaped at flagship row counts (8k rows /
+# 4 = 2k-row chunks); the planner prices this constant (plan/score.py).
+OVERLAP_CHUNKS = 4
+
+
+def _tp_overlap_matmul(x, w, axis_name, chunks):
+    parts = []
+    for xc in jnp.split(x, chunks, axis=0):
+        p = jax.lax.dot_general(
+            xc, w, (((x.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        # Reduce THIS chunk immediately: the next chunk's matmul issues
+        # while this allreduce is on the wire.
+        parts.append(jax.lax.psum(p, axis_name))
+    return jnp.concatenate(parts, axis=0).astype(x.dtype)
+
+
+TP_OVERLAP_MARKER = _tp_overlap_matmul.__name__
+
+_tp_overlap_matmul_jit = jax.jit(_tp_overlap_matmul, static_argnums=(2, 3))
+
+
+def tp_overlap_matmul(
+    x: jax.Array,
+    w: jax.Array,
+    *,
+    axis_name: str,
+    chunks: int = OVERLAP_CHUNKS,
+) -> jax.Array:
+    """psum-overlapped row-sharded matmul: ``psum(x @ w, axis_name)``
+    computed as ``chunks`` row-chunks with per-chunk reduces (module
+    docstring). Call INSIDE a ``shard_map`` region where ``axis_name``
+    is bound, with ``x`` [rows, k_local] the feature-sharded activation
+    and ``w`` [k_local, m] the local weight shard; rows must divide by
+    ``chunks``. Differentiable: autodiff transposes each per-chunk psum
+    exactly as it does the single fused reduce."""
+    if chunks < 1:
+        raise ValueError(f"chunks must be >= 1, got {chunks}")
+    try:  # bound-axis introspection has no stable public API
+        from jax._src.core import get_axis_env
+
+        size = get_axis_env().axis_size(axis_name)
+    except Exception:
+        size = None  # unbound axis: the psum below raises its own error
+    if size is not None and size <= 1:
+        # Same condition as the planner's capability row: without a
+        # model axis there is no reduce to hide — the chunked loop
+        # would only cost concat/split overhead.
+        reject("tp_overlap_needs_model_axis")
+    rows = x.shape[0]
+    if rows % chunks:
+        raise ValueError(
+            f"rows {rows} must divide by chunks {chunks} (pad the batch "
+            f"or pick a divisor; uneven chunks would recompile per shape)"
+        )
+    return _tp_overlap_matmul_jit(x, w, axis_name, chunks)

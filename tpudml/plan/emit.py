@@ -379,6 +379,7 @@ def engine_config(cand: Candidate) -> dict:
         "fused_xent": cand.fused_xent,
         "sentinel": cand.sentinel,
         "obs": cand.obs,
+        "tp_overlap": cand.tp_overlap,
         "aggregation": "allreduce",
     }
 

@@ -255,7 +255,19 @@ def score_candidate(
     if model > 1:
         # TP: two psums per block per direction of [B_dev, T, d] acts.
         act = (rows // data) * spec.seq_len * spec.embed_dim * spec.dtype_bytes
-        exposed += 4 * spec.num_layers * collective_wire_bytes("psum", act, model)
+        tp_wire = 4 * spec.num_layers * collective_wire_bytes("psum", act, model)
+        if cand.tp_overlap:
+            # Chunked collective-matmul placement (parallel/overlap.py):
+            # chunk i's psum rides under chunk i+1's matmul, so only the
+            # last chunk's reduce (1/K of the wire) stays exposed — the
+            # same exposed-vs-hidden attribution the zero1_overlap
+            # branch uses for its double-buffered gather.
+            from tpudml.parallel.overlap import OVERLAP_CHUNKS
+
+            exposed += tp_wire / OVERLAP_CHUNKS
+            hidden += tp_wire * (OVERLAP_CHUNKS - 1) / OVERLAP_CHUNKS
+        else:
+            exposed += tp_wire
         if cand.fused_xent:
             # vocab-sharded head: online lse-merge statistics, [B_dev, T]
             stats = 3 * (rows // data) * spec.seq_len * spec.dtype_bytes

@@ -87,6 +87,11 @@ class Candidate:
     fused_xent: bool
     sentinel: bool
     obs: bool
+    # Chunked psum-overlapped TP matmuls (parallel/overlap.py): hide
+    # (K−1)/K of the per-block activation allreduce behind the chunked
+    # matmul. Only meaningful with a model axis — the capability row
+    # ``tp_overlap_needs_model_axis`` prunes the rest of the lattice.
+    tp_overlap: bool = False
 
     @property
     def mesh_dict(self) -> dict:
@@ -99,6 +104,7 @@ class Candidate:
             f"z{int(self.zero1)}{int(self.zero1_overlap)}"
             f"a{self.accum_steps}f{int(self.fused_xent)}"
             f"s{int(self.sentinel)}o{int(self.obs)}"
+            f"t{int(self.tp_overlap)}"
         )
         return f"{self.engine}[{mesh}]{flags}"
 
@@ -113,6 +119,7 @@ class Candidate:
             "fused_xent": self.fused_xent,
             "sentinel": self.sentinel,
             "obs": self.obs,
+            "tp_overlap": self.tp_overlap,
             "aggregation": "allreduce",
             "schedule": "gpipe" if self.engine == "pp_dp" else None,
             "key": self.key(),
@@ -129,6 +136,7 @@ class Candidate:
             fused_xent=d["fused_xent"],
             sentinel=d["sentinel"],
             obs=d["obs"],
+            tp_overlap=d.get("tp_overlap", False),  # pre-v3 plan records
         )
 
 
@@ -191,15 +199,17 @@ def enumerate_candidates(
                     for fused in (False, True):
                         for sentinel in (False, True):
                             for obs in (False, True):
-                                out.append(Candidate(
-                                    engine=engine,
-                                    mesh=mesh,
-                                    zero1=engine == "zero1",
-                                    zero1_overlap=overlap,
-                                    accum_steps=accum,
-                                    fused_xent=fused,
-                                    sentinel=sentinel,
-                                    obs=obs,
-                                ))
+                                for tp_ov in (False, True):
+                                    out.append(Candidate(
+                                        engine=engine,
+                                        mesh=mesh,
+                                        zero1=engine == "zero1",
+                                        zero1_overlap=overlap,
+                                        accum_steps=accum,
+                                        fused_xent=fused,
+                                        sentinel=sentinel,
+                                        obs=obs,
+                                        tp_overlap=tp_ov,
+                                    ))
     out.sort(key=Candidate.key)
     return out

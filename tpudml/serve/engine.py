@@ -50,6 +50,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpudml.capabilities import CompositionError, reject
+from tpudml.ops.decode_head import fused_decode_head, fused_decode_head_int8
 from tpudml.serve.cache import KINDS
 from tpudml.serve.load import Request
 from tpudml.serve.paged import PAGED_DECODE_MARKER, PagePool
@@ -82,6 +83,37 @@ def make_decode_step(model):
         logits, caches = model.apply_decode(params, caches, tokens, pos)
         return jnp.argmax(logits, axis=-1).astype(jnp.int32), logits, caches
 
+    inner = jax.jit(_serve_decode_step)
+
+    def step(params, caches, tokens, pos):
+        return inner(params, caches, tokens, pos)
+
+    return jax.jit(step, donate_argnums=(1,))
+
+
+def make_fused_decode_step(model, head_q=None, head_scale=None):
+    """The fused-tail twin of :func:`make_decode_step`: the trunk runs to
+    post-``ln_f`` features (``apply_decode_features``) and the head
+    matmul, greedy pick, and step stats fold into ONE vocab-tiled Pallas
+    program (ops/decode_head.py) — the [slots, vocab] logits row never
+    round-trips HBM. Returns (next tokens [B], {"max_logit": [B],
+    "lse": [B]}, caches): same arity as the unfused step (the run loop
+    pulls tokens only), with the in-graph stats replacing the logits
+    output as the step's observable. With ``head_q``/``head_scale`` set
+    (int8 mode), the kernel consumes the int8 codes + scales directly,
+    dequantizing per vocab tile in the oracle's exact op order — the
+    dequantized f32 head never exists in HBM either."""
+
+    def _serve_decode_step(params, caches, tokens, pos):
+        h, caches = model.apply_decode_features(params, caches, tokens, pos)
+        bias = params["head"].get("bias")
+        if head_q is not None:
+            tok, mx, lse = fused_decode_head_int8(h, head_q, head_scale, bias)
+        else:
+            tok, mx, lse = fused_decode_head(h, params["head"]["kernel"], bias)
+        return tok, {"max_logit": mx, "lse": lse}, caches
+
+    assert _serve_decode_step.__name__ == SERVE_DECODE_MARKER
     inner = jax.jit(_serve_decode_step)
 
     def step(params, caches, tokens, pos):
@@ -191,6 +223,15 @@ class ServeConfig:
     # "int8_sim" is the f32-storage oracle (quantize→dequantize
     # round-trip) the real path must match bitwise. None: f32 weights.
     weight_quant: str | None = None
+    # Fused decode tail (ops/decode_head.py): fold the head matmul,
+    # greedy pick, and step stats into one vocab-tiled Pallas program —
+    # the [slots, vocab] logits row never materializes in HBM. Dense
+    # single-device layout only (capability row ``serve_fused_head_dense``
+    # rejects paged / speculative / TP composition at engine init).
+    # Composes with weight_quant: "int8" feeds the kernel the int8 codes
+    # + scales directly, "int8_sim" runs the f32 kernel on the oracle's
+    # round-tripped params.
+    fused_head: bool = False
 
     def __post_init__(self):
         if self.slots < 1:
@@ -420,6 +461,12 @@ class ServingEngine:
             # sharding the dequantized params would silently price (and
             # store) f32 while claiming int8 — reject instead.
             reject("serve_tp_weight_quant", exc=ServeCompositionError)
+        if cfg.fused_head and (mesh is not None or self._paged or cfg.spec_k):
+            # The fused tail consumes the dense step's post-ln features
+            # and the unsharded [d, V] head; paged/spec steps consume
+            # full logits windows and TP shards the head — run those
+            # unfused rather than silently falling back.
+            reject("serve_fused_head_dense", exc=ServeCompositionError)
         # Weight quantization happens ONCE at init: decode compute runs
         # on the dequantized params (bitwise identical to the int8_sim
         # oracle — quant.py's contract), while the "int8" mode keeps the
@@ -466,7 +513,16 @@ class ServingEngine:
                 self.caches = model.init_decode_cache(
                     cfg.slots, cfg.max_len, cfg.cache_kind
                 )
-                self._decode = make_decode_step(model)
+                if cfg.fused_head:
+                    hq = hs = None
+                    if self.quantized_params is not None:
+                        hq = self.quantized_params["head"]["kernel"]
+                        hs = self.quant_scales["head"]["kernel"]
+                    self._decode = make_fused_decode_step(
+                        model, head_q=hq, head_scale=hs
+                    )
+                else:
+                    self._decode = make_decode_step(model)
                 self._prefill_builder = self._build_prefill
             self._prefill_cache = {}
         # Paged bookkeeping: the host-side allocator plus the

@@ -66,7 +66,8 @@ class SLOConfig:
 class DecodeCostModel:
     """Static per-step cost of the serving engine's decode program.
 
-    bytes(step) = params_read + n_active × per_slot_window + spec_draft
+    bytes(step) = params_read + n_active × (per_slot_window + logits_tail)
+                  + spec_draft
     seconds(step) = bytes/hbm + ring_wire_bytes/ici
 
     The per-slot window is what the cache layout decides: the dense
@@ -104,6 +105,15 @@ class DecodeCostModel:
                 self._params_bytes(draft_model, itemsize=p_item)
                 // max(world, 1)
             )
+        # Decode tail: the unfused step writes each slot's [vocab] logits
+        # row to HBM and reads it back for the argmax + stats pass; the
+        # fused head (ops/decode_head.py) keeps the row in VMEM tiles, so
+        # its tail traffic is zero. Priced per slot so admission sees the
+        # fused tail's headroom at production vocab sizes.
+        if getattr(cfg, "fused_head", False):
+            self.tail_bytes_per_slot = 0
+        else:
+            self.tail_bytes_per_slot = 2 * model.vocab_size * 4
         # Two activation allreduces per block per step under TP (attn.out
         # + mlp.fc2 — serve/tp.py), priced on the shared ring model.
         act_bytes = model.embed_dim * 4
@@ -128,7 +138,7 @@ class DecodeCostModel:
         hbm = (
             self.params_bytes
             + self.spec_k * self.draft_bytes
-            + n_active * self.per_slot_bytes
+            + n_active * (self.per_slot_bytes + self.tail_bytes_per_slot)
         )
         wire = n_active * self.wire_bytes_per_slot
         return (
